@@ -1,0 +1,12 @@
+"""glt_tpu — a TPU-native graph-learning data engine.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capabilities of
+GraphLearn-for-PyTorch (graph storage, GPU-speed neighbor sampling, tiered
+feature lookup, loaders, partitioning, and distributed sampling), designed
+for TPU: static shapes, counter-based RNG, sort-based dedup instead of hash
+tables, and mesh collectives instead of RPC.
+"""
+
+__version__ = "0.1.0"
+
+from . import typing  # noqa: F401

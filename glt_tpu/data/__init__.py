@@ -1,0 +1,4 @@
+from .graph import Graph
+from .topology import CSRTopo
+
+__all__ = ["Graph", "CSRTopo"]

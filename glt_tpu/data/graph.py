@@ -1,0 +1,110 @@
+"""Device-resident graph storage.
+
+Rebuild of the reference's ``Graph`` (python/data/graph.py:124-239 +
+csrc/cuda/graph.cu).  The CUDA version has three residency modes — CPU,
+ZERO_COPY (pinned host memory read over UVA) and CUDA/DMA (full HBM copy).
+The TPU analogues are:
+
+* ``'DEVICE'`` — CSR arrays live in TPU HBM as jax Arrays (≈ DMA mode);
+* ``'HOST'``   — CSR stays in host numpy; sampling runs on CPU backend or
+  the arrays stream to device per call (≈ CPU mode).
+
+ZERO_COPY has no TPU equivalent (no UVA); its role — graphs larger than one
+device — is covered by sharding the graph across a mesh instead
+(:mod:`glt_tpu.parallel`).  Lazy init mirrors ``Graph.lazy_init``
+(data/graph.py:160-188).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .topology import CSRTopo
+
+_MODES = ("DEVICE", "HOST")
+
+
+class Graph:
+    """CSR graph with lazily materialised device arrays.
+
+    Args:
+      topo: host :class:`CSRTopo`.
+      mode: 'DEVICE' (HBM-resident) or 'HOST'.
+      with_sorted_columns: also build a column-sorted CSR view used by the
+        strict negative sampler's binary search
+        (csrc/cuda/random_negative_sampler.cu:37-54).
+    """
+
+    def __init__(self, topo: CSRTopo, mode: str = "DEVICE",
+                 with_sorted_columns: bool = False):
+        mode = mode.upper()
+        if mode not in _MODES:
+            raise ValueError(f"mode must be one of {_MODES}, got {mode!r}")
+        self.topo = topo
+        self.mode = mode
+        self._indptr: Optional[jnp.ndarray] = None
+        self._indices: Optional[jnp.ndarray] = None
+        self._edge_ids: Optional[jnp.ndarray] = None
+        self._sorted_indices: Optional[jnp.ndarray] = None
+        self._with_sorted_columns = with_sorted_columns
+
+    # -- lazy init (cf. data/graph.py:160-188) -----------------------------
+    def lazy_init(self) -> None:
+        if self._indptr is not None:
+            return
+        # ensure_compile_time_eval: materialisation must stay eager even when
+        # a Graph property is first touched inside a jit trace — otherwise
+        # tracers would be cached on the object and leak.
+        with jax.ensure_compile_time_eval():
+            as_arr = jnp.asarray if self.mode == "DEVICE" else np.asarray
+            self._indptr = as_arr(self.topo.indptr.astype(np.int32))
+            self._indices = as_arr(self.topo.indices.astype(np.int32))
+            self._edge_ids = as_arr(self.topo.edge_ids.astype(np.int32))
+            if self._with_sorted_columns:
+                srt = _sort_columns_within_rows(self.topo.indptr, self.topo.indices)
+                self._sorted_indices = as_arr(srt.astype(np.int32))
+
+    @property
+    def indptr(self) -> jnp.ndarray:
+        self.lazy_init()
+        return self._indptr
+
+    @property
+    def indices(self) -> jnp.ndarray:
+        self.lazy_init()
+        return self._indices
+
+    @property
+    def edge_ids(self) -> jnp.ndarray:
+        self.lazy_init()
+        return self._edge_ids
+
+    @property
+    def sorted_indices(self) -> jnp.ndarray:
+        if not self._with_sorted_columns:
+            self._with_sorted_columns = True
+            self._indptr = None  # force rebuild including the sorted view
+        self.lazy_init()
+        return self._sorted_indices
+
+    @property
+    def num_nodes(self) -> int:
+        return self.topo.num_nodes
+
+    @property
+    def num_edges(self) -> int:
+        return self.topo.num_edges
+
+    def __repr__(self) -> str:
+        return (f"Graph(num_nodes={self.num_nodes}, num_edges={self.num_edges},"
+                f" mode={self.mode!r})")
+
+
+def _sort_columns_within_rows(indptr: np.ndarray, indices: np.ndarray) -> np.ndarray:
+    """Sort neighbor ids within each CSR row (host-side, one-time prep)."""
+    row = np.repeat(np.arange(indptr.shape[0] - 1), np.diff(indptr))
+    order = np.lexsort((indices, row))
+    return np.asarray(indices)[order]

@@ -1,0 +1,102 @@
+"""CSRTopo — canonical host-side topology container.
+
+Rebuild of the reference's ``graphlearn_torch/python/data/graph.py:28-122``:
+accepts COO / CSR / CSC input and canonicalises to CSR, exposing
+``indptr / indices / edge_ids / degrees``.  The reference converts through
+``torch_sparse.SparseTensor``; here it's plain numpy (host prep only — device
+code consumes the finished arrays via :class:`glt_tpu.data.graph.Graph`).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+from ..utils.topo import coo_to_csr, csr_to_coo, degrees_from_ptr
+
+_LAYOUTS = ("COO", "CSR", "CSC")
+
+
+class CSRTopo:
+    """Graph topology stored as CSR over out-edges.
+
+    Args:
+      edge_index: ``[2, E]`` COO (row=src, col=dst) when layout is 'COO',
+        otherwise ``(indptr, indices)``.
+      edge_ids: optional ``[E]`` global edge ids (default: input positions).
+      layout: one of 'COO' | 'CSR' | 'CSC'. 'CSC' is interpreted as the
+        CSR of the reverse graph and transposed into out-edge CSR.
+      num_nodes: optional override for the node count.
+    """
+
+    def __init__(
+        self,
+        edge_index: Union[np.ndarray, Tuple[np.ndarray, np.ndarray]],
+        edge_ids: Optional[np.ndarray] = None,
+        layout: str = "COO",
+        num_nodes: Optional[int] = None,
+        edge_weights: Optional[np.ndarray] = None,
+    ):
+        layout = layout.upper()
+        if layout not in _LAYOUTS:
+            raise ValueError(f"layout must be one of {_LAYOUTS}, got {layout!r}")
+        if layout == "COO":
+            edge_index = np.asarray(edge_index)
+            row, col = edge_index[0], edge_index[1]
+        else:
+            indptr, indices = edge_index
+            indptr = np.asarray(indptr)
+            row, col = csr_to_coo(indptr, np.asarray(indices))
+            if layout == "CSC":
+                row, col = col, row
+            # The input indptr already encodes the node count (including
+            # trailing isolated nodes) — don't let it be re-derived from ids.
+            if num_nodes is None:
+                num_nodes = indptr.shape[0] - 1
+        self._indptr, self._indices, self._edge_ids, perm = coo_to_csr(
+            row, col, edge_ids, num_nodes, return_perm=True
+        )
+        # Per-edge payloads are stored in CSR order, aligned with indices.
+        self._edge_weights = (
+            None if edge_weights is None else np.asarray(edge_weights)[perm]
+        )
+
+    @property
+    def indptr(self) -> np.ndarray:
+        return self._indptr
+
+    @property
+    def indices(self) -> np.ndarray:
+        return self._indices
+
+    @property
+    def edge_ids(self) -> np.ndarray:
+        return self._edge_ids
+
+    @property
+    def edge_weights(self) -> Optional[np.ndarray]:
+        return self._edge_weights
+
+    @property
+    def num_nodes(self) -> int:
+        return self._indptr.shape[0] - 1
+
+    @property
+    def num_edges(self) -> int:
+        return int(self._indices.shape[0])
+
+    @property
+    def degrees(self) -> np.ndarray:
+        return degrees_from_ptr(self._indptr)
+
+    def out_degrees(self) -> np.ndarray:
+        return self.degrees
+
+    def in_degrees(self) -> np.ndarray:
+        return np.bincount(self._indices, minlength=self.num_nodes)
+
+    def to_coo(self) -> Tuple[np.ndarray, np.ndarray]:
+        return csr_to_coo(self._indptr, self._indices)
+
+    def __repr__(self) -> str:
+        return f"CSRTopo(num_nodes={self.num_nodes}, num_edges={self.num_edges})"

@@ -1,0 +1,13 @@
+from .neighbor_sample import NeighborOutput, lookup_degrees, sample_neighbors
+from .negative_sample import NegativeSampleOutput, edge_in_csr, sample_negative_edges
+from .stitch import stitch_sample_results
+from .subgraph import SubGraphOutput, node_subgraph
+from .unique import UniqueResult, relabel_by_reference, unique_first_occurrence
+
+__all__ = [
+    "NeighborOutput", "lookup_degrees", "sample_neighbors",
+    "NegativeSampleOutput", "edge_in_csr", "sample_negative_edges",
+    "stitch_sample_results",
+    "SubGraphOutput", "node_subgraph",
+    "UniqueResult", "relabel_by_reference", "unique_first_occurrence",
+]

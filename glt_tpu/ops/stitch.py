@@ -1,0 +1,41 @@
+"""Stitch per-partition partial sampling results back into seed order.
+
+Rebuild of ``csrc/cuda/stitch_sample_results.cu``: the CUDA kernel scatters
+each partition's neighbor runs into a global ragged output using index lists
+and a cumsum of neighbor counts (:27-56).  With static ``[B, fanout]`` blocks
+stitching degenerates to a single scatter per partition — no offsets needed.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..typing import PADDING_ID
+
+
+def stitch_sample_results(
+    num_seeds: int,
+    idx_list: Sequence[jnp.ndarray],
+    nbrs_list: Sequence[jnp.ndarray],
+    eids_list: Sequence[jnp.ndarray],
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter partition-local ``[b_p, fanout]`` blocks into seed order.
+
+    Args:
+      num_seeds: total number of seeds B.
+      idx_list: per partition, ``[b_p]`` original seed positions (-1 padded).
+      nbrs_list/eids_list: per partition, ``[b_p, fanout]`` sampled blocks.
+
+    Returns:
+      ``(nbrs, eids)`` of shape ``[B, fanout]``, -1 padded.
+    """
+    fanout = nbrs_list[0].shape[1]
+    nbrs = jnp.full((num_seeds + 1, fanout), PADDING_ID, jnp.int32)
+    eids = jnp.full((num_seeds + 1, fanout), PADDING_ID, jnp.int32)
+    for idx, nb, ei in zip(idx_list, nbrs_list, eids_list):
+        # -1 indices route to the spill row (num_seeds), sliced off below.
+        at = jnp.where(idx >= 0, idx, num_seeds)
+        nbrs = nbrs.at[at].set(nb.astype(jnp.int32))
+        eids = eids.at[at].set(ei.astype(jnp.int32))
+    return nbrs[:num_seeds], eids[:num_seeds]

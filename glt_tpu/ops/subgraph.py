@@ -1,0 +1,68 @@
+"""Induced-subgraph extraction over a node set, XLA-native.
+
+Rebuild of ``csrc/cuda/subgraph_op.cu``: the CUDA op inserts the node set
+into a hash table, scans every node's full CSR row keeping neighbors present
+in the set (GetNbrsNumKernel, subgraph_op.cu:34-68), prefix-sums, and emits
+relabeled rows/cols/eids.
+
+TPU design: membership testing uses :func:`relabel_by_reference` (sorted
+lookup instead of a hash probe), and the per-node row scan is bounded by a
+static ``max_degree`` cap so the output shape ``[S, max_degree]`` is known at
+trace time.  Callers size ``max_degree`` from host-side degree stats (the
+loader rounds it up to a power of two to bound recompilation).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+from ..typing import PADDING_ID
+from .neighbor_sample import _row_offsets_and_degrees
+from .unique import relabel_by_reference
+
+
+class SubGraphOutput(NamedTuple):
+    """Relabeled induced subgraph (cf. ``CUDASubGraphOp::NodeSubGraph``)."""
+    rows: jnp.ndarray  # [S * max_degree] local src index, -1 padded
+    cols: jnp.ndarray  # [S * max_degree] local dst index, -1 padded
+    eids: jnp.ndarray  # [S * max_degree] global edge ids, -1 padded
+    mask: jnp.ndarray  # [S * max_degree] bool
+
+
+def node_subgraph(
+    indptr: jnp.ndarray,
+    indices: jnp.ndarray,
+    nodes: jnp.ndarray,
+    max_degree: int,
+    edge_ids: Optional[jnp.ndarray] = None,
+) -> SubGraphOutput:
+    """Extract the subgraph induced by ``nodes`` (unique, -1 padded).
+
+    Edges whose source sits beyond ``max_degree`` entries into its CSR row
+    are dropped; callers must pick ``max_degree`` >= the max degree of the
+    node set for exact extraction (subgraph_op.cu:133 scans full rows — our
+    cap is the static-shape tradeoff, checked by the loader).
+    """
+    s = nodes.shape[0]
+    start, deg = _row_offsets_and_degrees(indptr, nodes.astype(jnp.int32))
+    start = start.astype(jnp.int32)
+
+    offs = jnp.arange(max_degree, dtype=jnp.int32)[None, :]          # [1, D]
+    in_row = offs < deg[:, None]                                     # [S, D]
+    flat = start[:, None] + jnp.where(in_row, offs, 0)
+    dst_global = jnp.where(in_row, indices[flat], PADDING_ID).astype(jnp.int32)
+
+    local_dst = relabel_by_reference(nodes, dst_global.ravel()).reshape(s, max_degree)
+    keep = in_row & (local_dst >= 0)
+
+    local_src = jnp.broadcast_to(
+        jnp.arange(s, dtype=jnp.int32)[:, None], (s, max_degree)
+    )
+    rows = jnp.where(keep, local_src, PADDING_ID).ravel()
+    cols = jnp.where(keep, local_dst, PADDING_ID).ravel()
+    if edge_ids is None:
+        eids = jnp.where(keep, flat, PADDING_ID).ravel()
+    else:
+        eids = jnp.where(keep, edge_ids[flat], PADDING_ID).ravel()
+    return SubGraphOutput(rows=rows, cols=cols, eids=eids.astype(jnp.int32), mask=keep.ravel())

@@ -1,0 +1,105 @@
+"""Static-shape, first-occurrence-order unique + relabel.
+
+This is the TPU-native replacement for the reference's GPU hash-table
+inducer (``csrc/cuda/hash_table.cu``, ``csrc/cuda/inducer.cu``): the CUDA
+design deduplicates node ids with an ``atomicCAS`` open-addressing table and
+emits unique keys in first-occurrence order.  Hash tables are a poor fit for
+the TPU's vector units, so we obtain identical semantics with sorts and
+segmented scans — O(M log M), fully static shapes, jit/vmap/shard_map safe.
+
+Key invariant preserved from the reference: unique ids come out in **first
+occurrence order**, so when seeds are placed at the front of the input, the
+output node list starts with the seeds — loaders rely on
+``batch.node[:batch_size] == seeds`` exactly as GLT does
+(csrc/cuda/inducer.cu:75-95, python/loader/node_loader.py:85).
+
+Negative ids are padding (PADDING_ID) and are ignored; they map to inverse
+index -1 and never appear among the unique ids.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+_INT32_MAX = jnp.iinfo(jnp.int32).max
+
+
+class UniqueResult(NamedTuple):
+    uniques: jnp.ndarray  # [M] unique ids in first-occurrence order, -1 padded
+    inverse: jnp.ndarray  # [M] position of each input id in `uniques` (-1 for padding)
+    count: jnp.ndarray    # [] int32 number of valid uniques
+
+
+def unique_first_occurrence(ids: jnp.ndarray) -> UniqueResult:
+    """Deduplicate ``ids`` preserving first-occurrence order.
+
+    Args:
+      ids: ``[M]`` int array; negative entries are padding.
+
+    Returns:
+      ``UniqueResult(uniques, inverse, count)`` with static shapes ``[M]``.
+    """
+    ids = ids.astype(jnp.int32)
+    m = ids.shape[0]
+    valid = ids >= 0
+    # Padding sorts to the back.
+    keys = jnp.where(valid, ids, _INT32_MAX)
+
+    # Stable sort so the head of each equal-id run carries the smallest
+    # original position == the first occurrence.
+    perm = jnp.argsort(keys, stable=True)
+    sorted_keys = keys[perm]
+
+    prev = jnp.concatenate([jnp.full((1,), -1, jnp.int32), sorted_keys[:-1]])
+    heads = (sorted_keys != prev) & (sorted_keys != _INT32_MAX)
+    # Run index of every sorted element (garbage for padding; masked later).
+    run_of_sorted = jnp.cumsum(heads.astype(jnp.int32)) - 1
+    count = jnp.sum(heads.astype(jnp.int32))
+
+    # Per-run first-occurrence position and id, scattered at head slots.
+    # Scatter target M+1 with an overflow slot for non-heads / padding.
+    scatter_idx = jnp.where(heads, run_of_sorted, m)
+    first_pos = (
+        jnp.full((m + 1,), _INT32_MAX, jnp.int32)
+        .at[scatter_idx]
+        .min(perm.astype(jnp.int32))[:m]
+    )
+    run_ids = (
+        jnp.full((m + 1,), -1, jnp.int32).at[scatter_idx].max(sorted_keys)[:m]
+    )
+    run_ids = jnp.where(run_ids == _INT32_MAX, -1, run_ids)
+
+    # Order runs by first occurrence; padding runs (first_pos == INT32_MAX)
+    # sort to the back.
+    order = jnp.argsort(first_pos, stable=True)
+    uniques = run_ids[order]
+
+    # rank[r] = final position of run r.
+    rank = jnp.zeros((m,), jnp.int32).at[order].set(jnp.arange(m, dtype=jnp.int32))
+    inv_sorted = rank[jnp.clip(run_of_sorted, 0, m - 1)]
+    inverse = jnp.zeros((m,), jnp.int32).at[perm].set(inv_sorted)
+    inverse = jnp.where(valid, inverse, -1)
+    return UniqueResult(uniques, inverse, count)
+
+
+def relabel_by_reference(reference_ids: jnp.ndarray, query_ids: jnp.ndarray) -> jnp.ndarray:
+    """Map each ``query_id`` to its position in ``reference_ids``.
+
+    ``reference_ids`` must be a -1-padded first-occurrence-unique list (as
+    produced by :func:`unique_first_occurrence`); every valid query id must
+    appear in it.  Returns -1 for padding queries.  This replaces the
+    reference's persistent per-batch hash-table lookups
+    (include/hash_table.cuh:43-55) with a sort-free searchsorted pass.
+    """
+    m = reference_ids.shape[0]
+    ref_keys = jnp.where(reference_ids >= 0, reference_ids, _INT32_MAX)
+    order = jnp.argsort(ref_keys)
+    sorted_ref = ref_keys[order]
+    q = jnp.where(query_ids >= 0, query_ids, _INT32_MAX - 1)
+    pos = jnp.searchsorted(sorted_ref, q)
+    pos = jnp.clip(pos, 0, m - 1)
+    hit = sorted_ref[pos] == q
+    local = jnp.where(hit, order[pos], -1)
+    return jnp.where(query_ids >= 0, local, -1).astype(jnp.int32)

@@ -1,0 +1,54 @@
+"""Small array utilities shared across layers.
+
+Mirrors the reference's ``graphlearn_torch/python/utils/tensor.py``
+(``id2idx`` dense inverse maps, conversion helpers) in numpy/jnp form.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+ArrayLike = Union[np.ndarray, jnp.ndarray, Sequence[int]]
+
+
+def id2idx(ids: ArrayLike, size: Optional[int] = None) -> np.ndarray:
+    """Dense inverse map: ``out[ids[i]] = i`` (utils/tensor.py:30).
+
+    Entries not present in ``ids`` map to 0, matching the reference's
+    zero-initialised map; callers mask separately when absence matters.
+    """
+    ids = np.asarray(ids)
+    if size is None:
+        size = int(ids.max()) + 1 if ids.size else 0
+    out = np.zeros(size, dtype=np.int64)
+    out[ids] = np.arange(ids.shape[0], dtype=np.int64)
+    return out
+
+
+def ensure_numpy(x: ArrayLike) -> np.ndarray:
+    if isinstance(x, np.ndarray):
+        return x
+    return np.asarray(x)
+
+
+def ensure_device(x: ArrayLike, dtype=None) -> jnp.ndarray:
+    return jnp.asarray(x, dtype=dtype)
+
+
+def pad_to(x: np.ndarray, size: int, fill) -> np.ndarray:
+    """Right-pad (or truncate) the leading axis of ``x`` to ``size``."""
+    n = x.shape[0]
+    if n == size:
+        return x
+    if n > size:
+        return x[:size]
+    pad_shape = (size - n,) + x.shape[1:]
+    return np.concatenate([x, np.full(pad_shape, fill, dtype=x.dtype)], axis=0)
+
+
+def next_power_of_two(n: int) -> int:
+    if n <= 1:
+        return 1
+    return 1 << (int(n - 1).bit_length())

@@ -1,0 +1,86 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from glt_tpu.data import CSRTopo, Graph
+from glt_tpu.ops import edge_in_csr, node_subgraph, sample_negative_edges
+
+
+def _random_graph(seed=0, n=40, e=300):
+    rng = np.random.default_rng(seed)
+    row, col = rng.integers(0, n, e), rng.integers(0, n, e)
+    topo = CSRTopo(np.stack([row, col]), num_nodes=n)
+    return topo, set(zip(row.tolist(), col.tolist())), n
+
+
+def test_edge_in_csr_matches_oracle():
+    topo, edges, n = _random_graph()
+    g = Graph(topo, with_sorted_columns=True)
+    rng = np.random.default_rng(1)
+    qs = rng.integers(0, n, 500)
+    qd = rng.integers(0, n, 500)
+    got = np.asarray(edge_in_csr(
+        g.indptr, g.sorted_indices, jnp.asarray(qs, jnp.int32), jnp.asarray(qd, jnp.int32)))
+    want = np.array([(s, d) in edges for s, d in zip(qs, qd)])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_edge_in_csr_padding_is_false():
+    topo, _, _ = _random_graph()
+    g = Graph(topo, with_sorted_columns=True)
+    got = np.asarray(edge_in_csr(
+        g.indptr, g.sorted_indices,
+        jnp.array([-1, 0], jnp.int32), jnp.array([0, -1], jnp.int32)))
+    assert not got.any()
+
+
+def test_strict_negative_sampling_avoids_edges():
+    topo, edges, n = _random_graph(seed=2, n=30, e=200)
+    g = Graph(topo, with_sorted_columns=True)
+    out = sample_negative_edges(
+        g.indptr, g.sorted_indices, num=256, key=jax.random.key(5),
+        num_nodes=n, trials=8, padding=False,
+    )
+    src, dst, mask = map(np.asarray, out)
+    assert mask.sum() > 200  # density ~0.22 per trial; 8 trials ⇒ nearly all filled
+    for s, d, m in zip(src, dst, mask):
+        if m:
+            assert (int(s), int(d)) not in edges
+
+
+def test_negative_sampling_with_padding_always_fills():
+    topo, _, n = _random_graph(seed=3)
+    g = Graph(topo, with_sorted_columns=True)
+    out = sample_negative_edges(
+        g.indptr, g.sorted_indices, num=64, key=jax.random.key(0),
+        num_nodes=n, trials=3, padding=True,
+    )
+    src, dst, mask = map(np.asarray, out)
+    assert mask.all()
+    assert ((src >= 0) & (src < n)).all() and ((dst >= 0) & (dst < n)).all()
+
+
+def test_node_subgraph_matches_oracle():
+    topo, edges, n = _random_graph(seed=4, n=25, e=150)
+    g = Graph(topo)
+    nodes = np.array([3, 7, 11, 19, 2, -1, -1])
+    out = node_subgraph(
+        g.indptr, g.indices, jnp.asarray(nodes, jnp.int32),
+        max_degree=int(topo.degrees.max()), edge_ids=g.edge_ids,
+    )
+    rows, cols, eids, mask = map(np.asarray, out)
+    nodeset = [int(v) for v in nodes if v >= 0]
+    want = set()
+    for i, u in enumerate(nodeset):
+        for j, v in enumerate(nodeset):
+            count = sum(1 for (a, b) in zip(*topo.to_coo()) if a == u and b == v)
+            for _ in range(count):
+                want.add((i, j))
+    got = set(zip(rows[mask].tolist(), cols[mask].tolist()))
+    assert got == want
+    # Edge ids reference real global edges consistent with the local pair.
+    r2, c2 = topo.to_coo()
+    for r, c, e, m in zip(rows, cols, eids, mask):
+        if m:
+            assert r2[np.where(topo.edge_ids == e)[0][0]] == nodeset[r]
+            assert c2[np.where(topo.edge_ids == e)[0][0]] == nodeset[c]

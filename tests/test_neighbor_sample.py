@@ -1,0 +1,109 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from glt_tpu.data import CSRTopo
+from glt_tpu.ops import lookup_degrees, sample_neighbors
+
+
+def _chain_graph():
+    # 0 -> {1,2,3,4,5}; 1 -> {2,3}; 2 -> {}; 3 -> {0}
+    row = np.array([0, 0, 0, 0, 0, 1, 1, 3])
+    col = np.array([1, 2, 3, 4, 5, 2, 3, 0])
+    return CSRTopo(np.stack([row, col]), num_nodes=6)
+
+
+def test_full_row_when_degree_leq_fanout():
+    t = _chain_graph()
+    out = sample_neighbors(
+        jnp.asarray(t.indptr), jnp.asarray(t.indices),
+        jnp.array([1, 2, 3], jnp.int32), fanout=4, key=jax.random.key(0),
+        edge_ids=jnp.asarray(t.edge_ids),
+    )
+    nbrs = np.asarray(out.nbrs)
+    mask = np.asarray(out.mask)
+    # deg <= fanout: the full (untruncated) neighbor list in CSR order.
+    assert nbrs[0, :2].tolist() == [2, 3] and not mask[0, 2:].any()
+    assert not mask[1].any() and (nbrs[1] == -1).all()
+    assert nbrs[2, 0] == 0 and not mask[2, 1:].any()
+    # Edge ids point at the right global edges.
+    eids = np.asarray(out.eids)
+    assert eids[0, :2].tolist() == [5, 6]
+    assert eids[2, 0] == 7
+
+
+@pytest.mark.parametrize("with_replacement", [False, True])
+def test_sampled_neighbors_are_real_edges(with_replacement):
+    rng = np.random.default_rng(3)
+    n, e = 64, 1024
+    row, col = rng.integers(0, n, e), rng.integers(0, n, e)
+    t = CSRTopo(np.stack([row, col]), num_nodes=n)
+    adj = {i: set() for i in range(n)}
+    for r, c in zip(row, col):
+        adj[r].add(c)
+    seeds = jnp.asarray(rng.integers(0, n, 32), jnp.int32)
+    out = sample_neighbors(
+        jnp.asarray(t.indptr), jnp.asarray(t.indices), seeds, fanout=5,
+        key=jax.random.key(7), with_replacement=with_replacement,
+    )
+    nbrs, mask = np.asarray(out.nbrs), np.asarray(out.mask)
+    for i, s in enumerate(np.asarray(seeds)):
+        deg = len(np.where(row == s)[0])
+        expected_valid = min(deg, 5) if not with_replacement else (5 if deg else 0)
+        assert mask[i].sum() == expected_valid
+        for k in range(5):
+            if mask[i, k]:
+                assert nbrs[i, k] in adj[int(s)]
+            else:
+                assert nbrs[i, k] == -1
+
+
+def test_without_replacement_has_no_duplicate_positions():
+    # A node with degree 100, fanout 10: sampled edge ids must be distinct.
+    row = np.zeros(100, dtype=np.int64)
+    col = np.arange(100, dtype=np.int64)
+    t = CSRTopo(np.stack([row, col]), num_nodes=101)
+    seeds = jnp.zeros((16,), jnp.int32)
+    out = sample_neighbors(
+        jnp.asarray(t.indptr), jnp.asarray(t.indices), seeds, fanout=10,
+        key=jax.random.key(11),
+    )
+    eids = np.asarray(out.eids)
+    for i in range(16):
+        assert len(set(eids[i].tolist())) == 10, eids[i]
+
+
+def test_floyd_uniformity():
+    # Every neighbor of a deg-8 node should be picked roughly equally when
+    # sampling 4 of 8 across many keys.
+    row = np.zeros(8, dtype=np.int64)
+    col = np.arange(8, dtype=np.int64)
+    t = CSRTopo(np.stack([row, col]), num_nodes=9)
+    counts = np.zeros(8)
+    trials = 600
+    sample = jax.jit(lambda k: sample_neighbors(
+        jnp.asarray(t.indptr), jnp.asarray(t.indices),
+        jnp.zeros((1,), jnp.int32), fanout=4, key=k).nbrs)
+    for s in range(trials):
+        nbrs = np.asarray(sample(jax.random.key(s)))[0]
+        counts[nbrs] += 1
+    freq = counts / trials
+    # Expected inclusion probability = 4/8 = 0.5.
+    assert np.all(np.abs(freq - 0.5) < 0.1), freq
+
+
+def test_padding_seeds():
+    t = _chain_graph()
+    out = sample_neighbors(
+        jnp.asarray(t.indptr), jnp.asarray(t.indices),
+        jnp.array([0, -1], jnp.int32), fanout=3, key=jax.random.key(0),
+    )
+    assert not np.asarray(out.mask)[1].any()
+    assert (np.asarray(out.nbrs)[1] == -1).all()
+
+
+def test_lookup_degrees():
+    t = _chain_graph()
+    deg = lookup_degrees(jnp.asarray(t.indptr), jnp.array([0, 1, 2, -1], jnp.int32))
+    assert np.asarray(deg).tolist() == [5, 2, 0, 0]

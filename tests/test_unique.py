@@ -1,0 +1,65 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from glt_tpu.ops import relabel_by_reference, unique_first_occurrence
+
+
+def _oracle_unique(ids):
+    """First-occurrence-order unique via numpy."""
+    seen, out = set(), []
+    for v in ids:
+        if v >= 0 and v not in seen:
+            seen.add(v)
+            out.append(v)
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_unique_first_occurrence_matches_oracle(seed):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 40, 128)
+    ids[rng.random(128) < 0.2] = -1  # padding holes
+    u, inv, cnt = jax.jit(unique_first_occurrence)(jnp.asarray(ids))
+    u, inv, cnt = np.asarray(u), np.asarray(inv), int(cnt)
+
+    want = _oracle_unique(ids.tolist())
+    assert cnt == len(want)
+    assert u[:cnt].tolist() == want
+    assert (u[cnt:] == -1).all()
+    # Inverse maps every valid input position back to its id.
+    for p, v in enumerate(ids.tolist()):
+        if v < 0:
+            assert inv[p] == -1
+        else:
+            assert u[inv[p]] == v
+
+
+def test_unique_seeds_stay_in_front():
+    # The loader invariant: seeds placed first come out first, in order.
+    seeds = jnp.array([9, 4, 7], jnp.int32)
+    nbrs = jnp.array([4, 11, 9, -1, 2, 7, 11], jnp.int32)
+    u, inv, cnt = unique_first_occurrence(jnp.concatenate([seeds, nbrs]))
+    assert np.asarray(u[:3]).tolist() == [9, 4, 7]
+    assert np.asarray(u[3:int(cnt)]).tolist() == [11, 2]
+
+
+def test_unique_all_padding():
+    u, inv, cnt = unique_first_occurrence(jnp.full((8,), -1, jnp.int32))
+    assert int(cnt) == 0
+    assert (np.asarray(u) == -1).all()
+    assert (np.asarray(inv) == -1).all()
+
+
+def test_relabel_by_reference():
+    ref = jnp.array([9, 4, 7, 11, 2, -1, -1], jnp.int32)
+    q = jnp.array([7, 2, 9, -1, 11, 4], jnp.int32)
+    local = np.asarray(relabel_by_reference(ref, q))
+    assert local.tolist() == [2, 4, 0, -1, 3, 1]
+
+
+def test_relabel_missing_id_returns_minus_one():
+    ref = jnp.array([5, 3, -1], jnp.int32)
+    q = jnp.array([3, 8, 5], jnp.int32)
+    assert np.asarray(relabel_by_reference(ref, q)).tolist() == [1, -1, 0]

@@ -1,0 +1,81 @@
+import numpy as np
+import pytest
+
+from glt_tpu.utils import (
+    coo_to_csr, csr_to_coo, id2idx, next_power_of_two, pad_to, parse_size, ptr2ind,
+)
+from glt_tpu.typing import as_str, edge_type_from_str, reverse_edge_type
+
+
+def test_coo_to_csr_roundtrip():
+    rng = np.random.default_rng(0)
+    n, e = 50, 300
+    row = rng.integers(0, n, e)
+    col = rng.integers(0, n, e)
+    indptr, indices, eids = coo_to_csr(row, col, num_nodes=n)
+    assert indptr.shape == (n + 1,)
+    assert indptr[-1] == e
+    # Every input edge appears exactly once, with the right edge id.
+    r2, c2 = csr_to_coo(indptr, indices)
+    got = sorted(zip(r2.tolist(), c2.tolist(), eids.tolist()))
+    want = sorted(zip(row.tolist(), col.tolist(), range(e)))
+    assert got == want
+
+
+def test_coo_to_csr_stable_within_row():
+    row = np.array([1, 1, 1, 0])
+    col = np.array([5, 3, 9, 2])
+    indptr, indices, eids = coo_to_csr(row, col, num_nodes=10)
+    # Row 1's neighbors keep input order (stable sort).
+    assert indices[indptr[1]:indptr[2]].tolist() == [5, 3, 9]
+    assert eids[indptr[1]:indptr[2]].tolist() == [0, 1, 2]
+
+
+def test_ptr2ind():
+    indptr = np.array([0, 2, 2, 5])
+    assert ptr2ind(indptr).tolist() == [0, 0, 2, 2, 2]
+
+
+def test_id2idx():
+    ids = np.array([7, 3, 5])
+    m = id2idx(ids, size=10)
+    assert m[7] == 0 and m[3] == 1 and m[5] == 2
+
+
+def test_parse_size():
+    assert parse_size("256MB") == 256 * 1024 ** 2
+    assert parse_size("1.5GB") == int(1.5 * 1024 ** 3)
+    assert parse_size(1024) == 1024
+    with pytest.raises(ValueError):
+        parse_size("12XB")
+
+
+def test_pad_to_and_pow2():
+    x = np.arange(3)
+    assert pad_to(x, 5, -1).tolist() == [0, 1, 2, -1, -1]
+    assert pad_to(x, 2, -1).tolist() == [0, 1]
+    assert next_power_of_two(5) == 8
+    assert next_power_of_two(1) == 1
+
+
+def test_csr_input_keeps_trailing_isolated_nodes():
+    from glt_tpu.data import CSRTopo
+    t = CSRTopo((np.array([0, 1, 1, 1]), np.array([0])), layout="CSR")
+    assert t.num_nodes == 3
+
+
+def test_edge_weights_realigned_to_csr_order():
+    from glt_tpu.data import CSRTopo
+    t = CSRTopo(np.stack([[1, 0], [5, 6]]), edge_weights=[0.9, 0.1])
+    assert t.indices.tolist() == [6, 5]
+    assert t.edge_weights.tolist() == [0.1, 0.9]
+
+
+def test_edge_type_helpers():
+    et = ("user", "clicks", "item")
+    assert as_str(et) == "user__clicks__item"
+    assert edge_type_from_str("user__clicks__item") == et
+    assert reverse_edge_type(et) == ("item", "rev_clicks", "user")
+    assert reverse_edge_type(reverse_edge_type(et)) == et
+    # Self-loops keep their relation name.
+    assert reverse_edge_type(("p", "cites", "p")) == ("p", "cites", "p")
