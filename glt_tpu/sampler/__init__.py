@@ -1,0 +1,21 @@
+from .base import (
+    BaseSampler,
+    EdgeSamplerInput,
+    HeteroSamplerOutput,
+    NegativeSampling,
+    NodeSamplerInput,
+    SamplerOutput,
+    SamplingConfig,
+)
+from .neighbor_sampler import NeighborSampler
+
+__all__ = [
+    "BaseSampler",
+    "EdgeSamplerInput",
+    "HeteroSamplerOutput",
+    "NegativeSampling",
+    "NodeSamplerInput",
+    "SamplerOutput",
+    "SamplingConfig",
+    "NeighborSampler",
+]

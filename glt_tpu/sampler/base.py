@@ -1,0 +1,204 @@
+"""Sampler input/output containers and the abstract sampler interface.
+
+Rebuild of the reference's ``graphlearn_torch/python/sampler/base.py`` —
+``NodeSamplerInput`` (base.py:44), ``EdgeSamplerInput`` (:149),
+``NegativeSampling`` (:84-145), ``SamplerOutput`` (:207),
+``HeteroSamplerOutput`` (:243), ``SamplingConfig`` (:334), ``BaseSampler``
+(:348) — re-expressed as JAX pytrees with **static shapes**: every array is
+padded to a trace-time-constant size with PADDING_ID sentinels, and ragged
+truths (how many nodes/edges were really sampled) travel as device scalars,
+never forcing a host sync.
+"""
+from __future__ import annotations
+
+import dataclasses
+from abc import ABC, abstractmethod
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..typing import EdgeType, NodeType
+
+
+@dataclasses.dataclass
+class NodeSamplerInput:
+    """Seed nodes for node-based sampling (cf. sampler/base.py:44).
+
+    ``node`` is a host numpy array of global node ids; ``input_type`` names
+    the seed node type for heterogeneous graphs.
+    """
+    node: np.ndarray
+    input_type: Optional[NodeType] = None
+
+    def __len__(self) -> int:
+        return int(self.node.shape[0])
+
+    def __getitem__(self, index) -> "NodeSamplerInput":
+        return NodeSamplerInput(self.node[index], self.input_type)
+
+    def share_memory(self) -> "NodeSamplerInput":
+        return self
+
+
+class NegativeSampling:
+    """Negative sampling spec (cf. sampler/base.py:84-145).
+
+    mode 'binary': per positive edge, ``amount`` negative edges are drawn and
+    labeled 0 (positives get 1).  mode 'triplet': per positive edge,
+    ``amount`` negative *destination* nodes are drawn for each source.
+    """
+    MODES = ("binary", "triplet")
+
+    def __init__(self, mode: str = "binary", amount: float = 1):
+        mode = mode.lower()
+        if mode not in self.MODES:
+            raise ValueError(f"mode must be one of {self.MODES}, got {mode!r}")
+        self.mode = mode
+        self.amount = amount
+
+    def is_binary(self) -> bool:
+        return self.mode == "binary"
+
+    def is_triplet(self) -> bool:
+        return self.mode == "triplet"
+
+    def sample_count(self, num_pos: int) -> int:
+        return int(round(num_pos * self.amount))
+
+
+@dataclasses.dataclass
+class EdgeSamplerInput:
+    """Seed edges for link-based sampling (cf. sampler/base.py:149)."""
+    row: np.ndarray
+    col: np.ndarray
+    label: Optional[np.ndarray] = None
+    input_type: Optional[EdgeType] = None
+    neg_sampling: Optional[NegativeSampling] = None
+
+    def __len__(self) -> int:
+        return int(self.row.shape[0])
+
+    def __getitem__(self, index) -> "EdgeSamplerInput":
+        return EdgeSamplerInput(
+            self.row[index],
+            self.col[index],
+            None if self.label is None else self.label[index],
+            self.input_type,
+            self.neg_sampling,
+        )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class SamplerOutput:
+    """Sampled ego-subgraph in local (relabeled) COO form.
+
+    Mirrors sampler/base.py:207, with the static-shape additions ``node_mask``
+    / ``edge_mask`` / ``num_nodes`` / ``num_edges``:
+
+    * ``node``: ``[max_nodes]`` global ids of batch-local nodes, in
+      first-occurrence order (seeds first), -1 padded.
+    * ``row`` / ``col``: ``[max_edges]`` local indices into ``node``; the
+      edge direction is already transposed to PyG's dst<-src convention
+      (row = neighbor, col = seed side), as in neighbor_sampler.py:159-165.
+    * ``edge``: ``[max_edges]`` global edge ids, -1 padded.
+    * ``batch``: ``[batch_size]`` the seed ids this batch was sampled for.
+    * ``num_sampled_nodes`` / ``num_sampled_edges``: per-hop valid counts
+      (device int32 vectors, lengths num_hops+1 / num_hops).
+    * ``metadata``: dict of extra arrays (edge_label_index, labels, ...).
+    """
+    node: jnp.ndarray
+    row: jnp.ndarray
+    col: jnp.ndarray
+    edge: jnp.ndarray
+    batch: Optional[jnp.ndarray] = None
+    node_mask: Optional[jnp.ndarray] = None
+    edge_mask: Optional[jnp.ndarray] = None
+    num_sampled_nodes: Optional[jnp.ndarray] = None
+    num_sampled_edges: Optional[jnp.ndarray] = None
+    input_type: Optional[Any] = None
+    metadata: Optional[Dict[str, Any]] = None
+
+    def tree_flatten(self):
+        children = (self.node, self.row, self.col, self.edge, self.batch,
+                    self.node_mask, self.edge_mask, self.num_sampled_nodes,
+                    self.num_sampled_edges, self.metadata)
+        return children, (self.input_type,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        (node, row, col, edge, batch, node_mask, edge_mask, nsn, nse,
+         metadata) = children
+        return cls(node, row, col, edge, batch, node_mask, edge_mask, nsn,
+                   nse, aux[0], metadata)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class HeteroSamplerOutput:
+    """Heterogeneous sampling result (cf. sampler/base.py:243).
+
+    Dicts keyed by node type / edge type; values have the same static-shape
+    semantics as :class:`SamplerOutput`.  Edge types in ``row``/``col``/
+    ``edge`` are the *reversed* types (dst<-src), as the reference emits
+    (neighbor_sampler.py:236-243).
+    """
+    node: Dict[NodeType, jnp.ndarray]
+    row: Dict[EdgeType, jnp.ndarray]
+    col: Dict[EdgeType, jnp.ndarray]
+    edge: Dict[EdgeType, jnp.ndarray]
+    batch: Optional[Dict[NodeType, jnp.ndarray]] = None
+    node_mask: Optional[Dict[NodeType, jnp.ndarray]] = None
+    edge_mask: Optional[Dict[EdgeType, jnp.ndarray]] = None
+    num_sampled_nodes: Optional[Dict[NodeType, jnp.ndarray]] = None
+    num_sampled_edges: Optional[Dict[EdgeType, jnp.ndarray]] = None
+    input_type: Optional[Any] = None
+    metadata: Optional[Dict[str, Any]] = None
+
+    def tree_flatten(self):
+        children = (self.node, self.row, self.col, self.edge, self.batch,
+                    self.node_mask, self.edge_mask, self.num_sampled_nodes,
+                    self.num_sampled_edges, self.metadata)
+        return children, (self.input_type,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        (node, row, col, edge, batch, node_mask, edge_mask, nsn, nse,
+         metadata) = children
+        return cls(node, row, col, edge, batch, node_mask, edge_mask, nsn,
+                   nse, aux[0], metadata)
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """Static sampling plan (cf. sampler/base.py:334 ``SamplingConfig``).
+
+    Everything here is trace-time constant: it determines compiled shapes.
+    ``max_nodes``/``max_edges`` cap the padded batch-subgraph size; ``None``
+    means the exact worst-case bound batch * prod(fanouts) (mirroring
+    ``_max_sampled_nodes``, neighbor_sampler.py:595-612), which is safe but
+    can be lowered substantially for power-law graphs to save HBM.
+    """
+    num_neighbors: Any = None          # List[int] or Dict[EdgeType, List[int]]
+    batch_size: int = 512
+    with_edge: bool = True
+    with_neg: bool = False
+    with_weight: bool = False
+    collect_features: bool = True
+    max_nodes: Optional[int] = None
+    max_edges: Optional[int] = None
+    seed: int = 0
+
+
+class BaseSampler(ABC):
+    """Abstract sampler interface (cf. sampler/base.py:348)."""
+
+    @abstractmethod
+    def sample_from_nodes(self, inputs: NodeSamplerInput, **kwargs):
+        raise NotImplementedError
+
+    @abstractmethod
+    def sample_from_edges(self, inputs: EdgeSamplerInput, **kwargs):
+        raise NotImplementedError
