@@ -1,4 +1,7 @@
+from .dataset import Dataset
+from .feature import Feature
 from .graph import Graph
+from .reorder import sort_by_in_degree
 from .topology import CSRTopo
 
-__all__ = ["Graph", "CSRTopo"]
+__all__ = ["Dataset", "Feature", "Graph", "CSRTopo", "sort_by_in_degree"]

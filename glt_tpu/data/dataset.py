@@ -1,0 +1,164 @@
+"""Dataset — bundle of graph topology, features, and labels (homo & hetero).
+
+Rebuild of the reference's ``Dataset`` (python/data/dataset.py:29-336):
+``init_graph / init_node_features / init_edge_features / init_node_labels``
+plus hetero accessors (``get_node_types`` etc., dataset.py:238-278).  Hetero
+data are dicts keyed by ``NodeType`` / ``EdgeType`` exactly as there.  The
+IPC-sharing machinery (ForkingPickler, CUDA IPC) has no TPU role — device
+residency is handled by jax Arrays and, across processes, by the loader's
+host pipeline.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+from ..typing import EdgeType, NodeType
+from .feature import Feature
+from .graph import Graph
+from .reorder import sort_by_in_degree
+from .topology import CSRTopo
+
+GraphLike = Union[Graph, Dict[EdgeType, Graph]]
+FeatureLike = Union[Feature, Dict[Union[NodeType, EdgeType], Feature]]
+
+
+class Dataset:
+    """Graph(s) + node/edge features + labels.
+
+    All init methods accept either a single object (homogeneous) or a dict
+    keyed by node/edge type (heterogeneous), mirroring dataset.py:44-219.
+    """
+
+    def __init__(
+        self,
+        graph: Optional[GraphLike] = None,
+        node_features: Optional[FeatureLike] = None,
+        edge_features: Optional[FeatureLike] = None,
+        node_labels: Optional[Union[np.ndarray, Dict[NodeType, np.ndarray]]] = None,
+        edge_dir: str = "out",
+    ):
+        self.graph = graph
+        self.node_features = node_features
+        self.edge_features = edge_features
+        self.node_labels = node_labels
+        self.edge_dir = edge_dir
+
+    # -- init methods (cf. dataset.py:44-219) ------------------------------
+    def init_graph(
+        self,
+        edge_index=None,
+        edge_ids=None,
+        layout: Union[str, Dict[EdgeType, str]] = "COO",
+        graph_mode: str = "DEVICE",
+        num_nodes=None,
+        with_sorted_columns: bool = False,
+    ) -> "Dataset":
+        if isinstance(edge_index, dict):
+            graphs: Dict[EdgeType, Graph] = {}
+            for etype, ei in edge_index.items():
+                eids = None if edge_ids is None else edge_ids.get(etype)
+                lo = layout[etype] if isinstance(layout, dict) else layout
+                nn = num_nodes.get(etype[2]) if isinstance(num_nodes, dict) else None
+                topo = CSRTopo(ei, edge_ids=eids, layout=lo, num_nodes=nn)
+                graphs[etype] = Graph(topo, mode=graph_mode,
+                                      with_sorted_columns=with_sorted_columns)
+            self.graph = graphs
+        elif edge_index is not None:
+            topo = CSRTopo(edge_index, edge_ids=edge_ids, layout=layout,
+                           num_nodes=num_nodes)
+            self.graph = Graph(topo, mode=graph_mode,
+                               with_sorted_columns=with_sorted_columns)
+        return self
+
+    def init_node_features(
+        self,
+        node_feature_data=None,
+        id2idx=None,
+        sort_func=None,
+        split_ratio: float = 1.0,
+        dtype=None,
+    ) -> "Dataset":
+        """Build the tiered node feature store.
+
+        ``sort_func`` defaults to in-degree hotness reordering when
+        ``split_ratio < 1`` and a homogeneous graph is present (mirroring
+        dataset.py's use of ``sort_by_in_degree``).
+        """
+        if isinstance(node_feature_data, dict):
+            feats: Dict[NodeType, Feature] = {}
+            for ntype, arr in node_feature_data.items():
+                i2i = None if id2idx is None else id2idx.get(ntype)
+                feats[ntype] = Feature(arr, split_ratio=split_ratio,
+                                       id2index=i2i, dtype=dtype)
+            self.node_features = feats
+        elif node_feature_data is not None:
+            arr, i2i = np.asarray(node_feature_data), id2idx
+            if i2i is None and split_ratio < 1.0 and isinstance(self.graph, Graph):
+                fn = sort_func or sort_by_in_degree
+                arr, i2i = fn(arr, split_ratio, self.graph.topo)
+            self.node_features = Feature(arr, split_ratio=split_ratio,
+                                         id2index=i2i, dtype=dtype)
+        return self
+
+    def init_edge_features(self, edge_feature_data=None, id2idx=None,
+                           split_ratio: float = 1.0, dtype=None) -> "Dataset":
+        if isinstance(edge_feature_data, dict):
+            self.edge_features = {
+                etype: Feature(arr, split_ratio=split_ratio,
+                               id2index=None if id2idx is None else id2idx.get(etype),
+                               dtype=dtype)
+                for etype, arr in edge_feature_data.items()}
+        elif edge_feature_data is not None:
+            self.edge_features = Feature(edge_feature_data,
+                                         split_ratio=split_ratio,
+                                         id2index=id2idx, dtype=dtype)
+        return self
+
+    def init_node_labels(self, node_label_data=None) -> "Dataset":
+        if isinstance(node_label_data, dict):
+            self.node_labels = {k: np.asarray(v)
+                                for k, v in node_label_data.items()}
+        elif node_label_data is not None:
+            self.node_labels = np.asarray(node_label_data)
+        return self
+
+    # -- hetero accessors (cf. dataset.py:238-278) -------------------------
+    @property
+    def is_hetero(self) -> bool:
+        return isinstance(self.graph, dict)
+
+    def get_node_types(self) -> List[NodeType]:
+        if not self.is_hetero:
+            return []
+        types = set()
+        for (src, _, dst) in self.graph.keys():
+            types.add(src)
+            types.add(dst)
+        return sorted(types)
+
+    def get_edge_types(self) -> List[EdgeType]:
+        if not self.is_hetero:
+            return []
+        return sorted(self.graph.keys())
+
+    def get_graph(self, etype: Optional[EdgeType] = None) -> Optional[Graph]:
+        if isinstance(self.graph, dict):
+            return self.graph.get(etype)
+        return self.graph
+
+    def get_node_feature(self, ntype: Optional[NodeType] = None):
+        if isinstance(self.node_features, dict):
+            return self.node_features.get(ntype)
+        return self.node_features
+
+    def get_edge_feature(self, etype: Optional[EdgeType] = None):
+        if isinstance(self.edge_features, dict):
+            return self.edge_features.get(etype)
+        return self.edge_features
+
+    def get_node_label(self, ntype: Optional[NodeType] = None):
+        if isinstance(self.node_labels, dict):
+            return self.node_labels.get(ntype)
+        return self.node_labels

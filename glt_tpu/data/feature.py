@@ -1,0 +1,150 @@
+"""Tiered feature store: HBM-resident hot rows + host-DRAM cold rows.
+
+Rebuild of the reference's two-tier feature system (python/data/feature.py +
+csrc/cuda/unified_tensor.cu): there, a ``split_ratio`` fraction of rows is
+sharded across an NVLink clique's GPUs and the remainder is pinned host
+memory read through UVA, with a warp-per-row gather kernel choosing the
+source by binary-scanning shard offsets (unified_tensor.cu:35-81).
+
+TPU redesign — no UVA, no IPC handles:
+
+* the **hot tier** is a plain ``jax.Array`` in device HBM (sharding it
+  across a mesh is the :mod:`glt_tpu.parallel` layer's job, the analog of
+  the reference's ``DeviceGroup`` replication, feature.py:31-45);
+* the **cold tier** stays in host numpy and is gathered eagerly on the
+  host, overlapped with device compute by the loader's prefetch pipeline —
+  the role UVA reads played on GPU (the TPU runtime in use does not support
+  host callbacks inside jit, so the cold path is a host-side stage, exactly
+  where the reference put its CPU fallback, feature.py:156);
+* the ``id2index`` indirection (feature.py:141-154) is identical: lookups
+  translate global ids through the hotness reordering of
+  :func:`~glt_tpu.data.reorder.sort_by_in_degree`.
+
+``gather`` is jit-safe when the store is fully device-resident
+(``split_ratio == 1.0``); tiered stores gather eagerly with a static output
+shape ``[B, d]``.  Padding ids (< 0) return zero rows either way.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Feature:
+    """Row-gatherable feature matrix with hot/cold tiering.
+
+    Args:
+      feature_array: ``[N, d]`` host array (already hotness-reordered if
+        ``id2index`` is given).
+      split_ratio: fraction of rows resident in device HBM (the rest stays
+        on host).  1.0 = fully device-resident, 0.0 = fully host.
+      id2index: optional ``[N]`` indirection from global id to row.
+      dtype: optional cast applied to gathered rows (e.g. ``jnp.bfloat16``).
+    """
+
+    def __init__(
+        self,
+        feature_array: np.ndarray,
+        split_ratio: float = 1.0,
+        id2index: Optional[np.ndarray] = None,
+        dtype=None,
+    ):
+        feature_array = np.asarray(feature_array)
+        if feature_array.ndim == 1:
+            feature_array = feature_array[:, None]
+        self._n, self._dim = feature_array.shape
+        self.split_ratio = float(split_ratio)
+        self._hot_count = int(self._n * self.split_ratio)
+        self.dtype = dtype or jnp.asarray(feature_array[:1]).dtype
+
+        self._hot = jnp.asarray(feature_array[: self._hot_count], self.dtype)
+        # Host tier; kept as a contiguous numpy view for fast np.take.
+        self._cold = np.ascontiguousarray(feature_array[self._hot_count:])
+        self._id2index = (
+            None if id2index is None else jnp.asarray(id2index, jnp.int32))
+        self._id2index_np = (
+            None if id2index is None else np.asarray(id2index, np.int32))
+        self._host_full = feature_array  # for cpu_get / save paths
+
+    # -- shape info --------------------------------------------------------
+    @property
+    def shape(self):
+        return (self._n, self._dim)
+
+    @property
+    def size(self) -> int:
+        return self._n
+
+    @property
+    def hot_count(self) -> int:
+        return self._hot_count
+
+    @property
+    def id2index(self):
+        return self._id2index
+
+    # -- gather ------------------------------------------------------------
+    def gather(self, ids: jnp.ndarray) -> jnp.ndarray:
+        """Gather rows for global ``ids`` (-1 padded).
+
+        Fully device-resident stores (``split_ratio == 1.0``) are jit-safe.
+        Tiered stores run the hot gather on device and the cold gather on
+        host, merging on device — callable only eagerly (the loader stages
+        it before the jitted train step).  Padding rows are zeros.
+        """
+        if self._cold.shape[0] == 0:
+            ids = jnp.asarray(ids, jnp.int32)
+            valid = ids >= 0
+            idx = jnp.where(valid, ids, 0)
+            if self._id2index is not None:
+                idx = self._id2index[idx]
+            rows = jnp.take(self._hot, idx, axis=0, mode="clip")
+            return jnp.where(valid[:, None], rows, 0)
+
+        if isinstance(ids, jax.core.Tracer):
+            raise ValueError(
+                "tiered Feature.gather (split_ratio < 1) is a host-side "
+                "stage and cannot run under jit; gather before the jitted "
+                "step or use split_ratio=1.0")
+        ids_np = np.asarray(ids).astype(np.int64)
+        valid = ids_np >= 0
+        idx = np.where(valid, ids_np, 0)
+        if self._id2index_np is not None:
+            idx = self._id2index_np[idx]
+        is_hot = idx < self._hot_count
+        # Device gather for the hot rows, host gather for the cold rows.
+        hot_rows = jnp.take(self._hot,
+                            jnp.asarray(np.where(is_hot, idx, 0), jnp.int32),
+                            axis=0, mode="clip")
+        cold_np = np.take(self._cold,
+                          np.clip(np.where(is_hot, 0, idx - self._hot_count),
+                                  0, max(self._cold.shape[0] - 1, 0)),
+                          axis=0)
+        cold_rows = jnp.asarray(cold_np, self.dtype)
+        mask = jnp.asarray(is_hot & valid)[:, None]
+        vmask = jnp.asarray(valid)[:, None]
+        return jnp.where(mask, hot_rows, jnp.where(vmask, cold_rows, 0))
+
+    def __getitem__(self, ids) -> jnp.ndarray:
+        return self.gather(jnp.atleast_1d(jnp.asarray(ids)))
+
+    def cpu_get(self, ids: np.ndarray) -> np.ndarray:
+        """Pure host-side lookup (cf. feature.py:156 ``cpu_get``)."""
+        ids = np.atleast_1d(np.asarray(ids))
+        valid = ids >= 0
+        idx = np.where(valid, ids, 0)
+        if self._id2index is not None:
+            idx = np.asarray(self._id2index)[idx]
+        rows = self._host_full[idx]
+        rows = np.where(valid[:, None], rows, 0)
+        return rows
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __repr__(self) -> str:
+        return (f"Feature(shape={self.shape}, split_ratio={self.split_ratio},"
+                f" hot={self._hot_count})")

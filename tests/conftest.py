@@ -7,7 +7,10 @@ for a TPU slice.  Must run before the first jax import.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment points JAX_PLATFORMS at the real TPU
+# tunnel (axon), which is reserved for benchmarking — tests always run on
+# the virtual device mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,110 @@
+"""Feature store + reorder tests (cf. test/python/test_feature.py)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from glt_tpu.data.feature import Feature
+from glt_tpu.data.reorder import sort_by_in_degree
+from glt_tpu.data.topology import CSRTopo
+from glt_tpu.data.dataset import Dataset
+
+
+def star_topo(n=10):
+    # everyone points at node 0 -> node 0 has max in-degree
+    src = np.arange(1, n)
+    dst = np.zeros(n - 1, np.int64)
+    return CSRTopo(np.stack([src, dst]), num_nodes=n)
+
+
+class TestFeature:
+    def test_full_device_gather(self):
+        arr = np.arange(20, dtype=np.float32).reshape(10, 2)
+        f = Feature(arr, split_ratio=1.0)
+        got = np.asarray(f[jnp.array([3, 0, 9])])
+        np.testing.assert_array_equal(got, arr[[3, 0, 9]])
+
+    def test_padding_rows_zero(self):
+        arr = np.ones((5, 3), np.float32)
+        f = Feature(arr, split_ratio=1.0)
+        got = np.asarray(f[jnp.array([2, -1, 4])])
+        assert (got[1] == 0).all() and (got[0] == 1).all()
+
+    def test_tiered_gather_matches_host(self):
+        rng = np.random.default_rng(0)
+        arr = rng.normal(size=(32, 4)).astype(np.float32)
+        f = Feature(arr, split_ratio=0.25)  # 8 hot rows, 24 cold
+        ids = jnp.array([0, 7, 8, 31, 15, -1])
+        got = np.asarray(f.gather(ids))
+        want = np.vstack([arr[[0, 7, 8, 31, 15]], np.zeros((1, 4), np.float32)])
+        np.testing.assert_allclose(got, want)
+
+    def test_full_device_gather_under_jit(self):
+        arr = np.arange(24, dtype=np.float32).reshape(12, 2)
+        f = Feature(arr, split_ratio=1.0)
+        fn = jax.jit(lambda i: f.gather(i).sum(axis=1))
+        got = np.asarray(fn(jnp.array([11, 2, 5])))
+        np.testing.assert_allclose(got, arr[[11, 2, 5]].sum(axis=1))
+
+    def test_tiered_gather_rejects_jit(self):
+        import pytest
+        arr = np.arange(24, dtype=np.float32).reshape(12, 2)
+        f = Feature(arr, split_ratio=0.5)
+        with pytest.raises(ValueError, match="host-side"):
+            jax.jit(f.gather)(jnp.array([1, 2]))
+
+    def test_id2index_indirection(self):
+        arr = np.arange(10, dtype=np.float32)[:, None]
+        perm = np.array([3, 1, 4, 0, 2], np.int32)  # id -> row
+        f = Feature(arr[:5], split_ratio=1.0, id2index=perm)
+        got = np.asarray(f[jnp.array([0, 4])])
+        np.testing.assert_array_equal(got[:, 0], [arr[3, 0], arr[2, 0]])
+
+    def test_cpu_get(self):
+        arr = np.arange(12, dtype=np.float32).reshape(6, 2)
+        f = Feature(arr, split_ratio=0.5)
+        np.testing.assert_array_equal(f.cpu_get(np.array([5, 0])), arr[[5, 0]])
+
+
+class TestReorder:
+    def test_hottest_first(self):
+        topo = star_topo(10)
+        feat = np.arange(10, dtype=np.float32)[:, None]
+        re, id2idx = sort_by_in_degree(feat, 0.2, topo)
+        assert re[0, 0] == 0.0          # node 0 (hottest) is first row
+        assert id2idx[0] == 0
+        # round trip: re[id2idx[i]] == feat[i]
+        np.testing.assert_array_equal(re[id2idx], feat)
+
+    def test_feature_with_reorder(self):
+        topo = star_topo(8)
+        feat = np.arange(8, dtype=np.float32)[:, None] * 10
+        re, id2idx = sort_by_in_degree(feat, 0.25, topo)
+        f = Feature(re, split_ratio=0.25, id2index=id2idx)
+        got = np.asarray(f[jnp.arange(8)])
+        np.testing.assert_array_equal(got, feat)
+
+
+class TestDataset:
+    def test_homo_roundtrip(self):
+        topo_edges = np.array([[0, 1, 2], [1, 2, 0]])
+        ds = (Dataset()
+              .init_graph(topo_edges, graph_mode="HOST", num_nodes=3)
+              .init_node_features(np.eye(3, dtype=np.float32))
+              .init_node_labels(np.array([0, 1, 0])))
+        assert not ds.is_hetero
+        assert ds.get_graph().num_nodes == 3
+        np.testing.assert_array_equal(
+            np.asarray(ds.get_node_feature()[jnp.array([1])])[0],
+            [0.0, 1.0, 0.0])
+        assert ds.get_node_label()[2] == 0
+
+    def test_hetero(self):
+        ei = {("user", "likes", "item"): np.array([[0, 1], [1, 0]]),
+              ("item", "rev_likes", "user"): np.array([[1, 0], [0, 1]])}
+        ds = Dataset().init_graph(
+            ei, graph_mode="HOST",
+            num_nodes={"user": 2, "item": 2})
+        assert ds.is_hetero
+        assert ds.get_node_types() == ["item", "user"]
+        assert len(ds.get_edge_types()) == 2
+        assert ds.get_graph(("user", "likes", "item")).num_nodes == 2
