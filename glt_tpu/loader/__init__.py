@@ -1,0 +1,16 @@
+from .link_loader import LinkLoader, LinkNeighborLoader
+from .node_loader import NeighborLoader, NodeLoader
+from .subgraph_loader import SubGraphLoader
+from .transform import Batch, HeteroBatch, to_batch, to_hetero_batch
+
+__all__ = [
+    "Batch",
+    "HeteroBatch",
+    "LinkLoader",
+    "LinkNeighborLoader",
+    "NeighborLoader",
+    "NodeLoader",
+    "SubGraphLoader",
+    "to_batch",
+    "to_hetero_batch",
+]

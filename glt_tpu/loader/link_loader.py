@@ -1,0 +1,100 @@
+"""LinkLoader / LinkNeighborLoader — seed-edge loaders for link prediction.
+
+Rebuild of ``loader/link_loader.py`` + ``loader/link_neighbor_loader.py``:
+seed edges drive ``sample_from_edges`` with optional binary/triplet negative
+sampling; the batch carries ``edge_label_index`` / ``edge_label`` (binary)
+or ``src_index`` / ``dst_pos_index`` / ``dst_neg_index`` (triplet) metadata,
+with the reference's label-increment semantics (link_loader.py:111-216).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..data.dataset import Dataset
+from ..sampler.base import EdgeSamplerInput, NegativeSampling
+from ..sampler.neighbor_sampler import NeighborSampler
+from .node_loader import NodeLoader
+from .transform import Batch, to_batch
+
+
+class LinkLoader(NodeLoader):
+    """Iterate seed-edge batches through ``sample_from_edges``.
+
+    Args:
+      edge_label_index: ``[2, num_edges]`` seed edges (global ids).
+      edge_label: optional labels per seed edge.
+      neg_sampling: :class:`NegativeSampling` spec or None.
+    """
+
+    def __init__(
+        self,
+        data: Dataset,
+        link_sampler,
+        edge_label_index: np.ndarray,
+        edge_label: Optional[np.ndarray] = None,
+        neg_sampling: Optional[NegativeSampling] = None,
+        batch_size: int = 512,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        prefetch: int = 2,
+        seed: int = 0,
+    ):
+        eli = np.asarray(edge_label_index)
+        super().__init__(data, link_sampler, np.arange(eli.shape[1]),
+                         batch_size=batch_size, shuffle=shuffle,
+                         drop_last=drop_last, prefetch=prefetch, seed=seed)
+        self.edge_label_index = eli
+        self.edge_label = (None if edge_label is None
+                           else np.asarray(edge_label))
+        self.neg_sampling = neg_sampling
+
+    def __iter__(self) -> Iterator[Batch]:
+        pending = deque()
+        batches = self._epoch_seed_batches()  # batches of edge positions
+        while True:
+            while len(pending) < self.prefetch:
+                pos = next(batches, None)
+                if pos is None:
+                    break
+                inp = EdgeSamplerInput(
+                    row=self.edge_label_index[0, pos],
+                    col=self.edge_label_index[1, pos],
+                    label=None if self.edge_label is None
+                    else self.edge_label[pos],
+                    neg_sampling=self.neg_sampling)
+                pending.append(
+                    (self.sampler.sample_from_edges(inp), pos.shape[0]))
+            if not pending:
+                return
+            out, npos = pending.popleft()
+            yield self._collate_fn(out, npos)
+
+
+class LinkNeighborLoader(LinkLoader):
+    """Link loader with neighbor sampling (cf. link_neighbor_loader.py:27)."""
+
+    def __init__(
+        self,
+        data: Dataset,
+        num_neighbors: Sequence[int],
+        edge_label_index: np.ndarray,
+        edge_label: Optional[np.ndarray] = None,
+        neg_sampling: Optional[NegativeSampling] = None,
+        batch_size: int = 512,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        frontier_cap: Optional[int] = None,
+        prefetch: int = 2,
+        seed: int = 0,
+    ):
+        sampler = NeighborSampler(
+            data.get_graph(), num_neighbors, batch_size=batch_size,
+            frontier_cap=frontier_cap, seed=seed)
+        super().__init__(data, sampler, edge_label_index,
+                         edge_label=edge_label, neg_sampling=neg_sampling,
+                         batch_size=batch_size, shuffle=shuffle,
+                         drop_last=drop_last, prefetch=prefetch, seed=seed)
+        self.num_neighbors = list(num_neighbors)

@@ -1,0 +1,59 @@
+"""SubGraphLoader — induced-subgraph batches (cf. loader/subgraph_loader.py).
+
+Drives ``NeighborSampler.subgraph``: hop expansion to collect a node set,
+then exact induced-subgraph extraction, with ``mapping`` metadata locating
+the seeds inside the batch (subgraph_loader.py:89-98).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..data.dataset import Dataset
+from ..sampler.base import NodeSamplerInput
+from ..sampler.neighbor_sampler import NeighborSampler
+from .node_loader import NodeLoader
+from .transform import Batch
+
+
+class SubGraphLoader(NodeLoader):
+    def __init__(
+        self,
+        data: Dataset,
+        num_neighbors: Sequence[int],
+        input_nodes: np.ndarray,
+        batch_size: int = 64,
+        max_degree: int = 64,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        prefetch: int = 2,
+        seed: int = 0,
+        sampler: Optional[NeighborSampler] = None,
+    ):
+        if sampler is None:
+            sampler = NeighborSampler(
+                data.get_graph(), num_neighbors, batch_size=batch_size,
+                seed=seed)
+        super().__init__(data, sampler, input_nodes, batch_size=batch_size,
+                         shuffle=shuffle, drop_last=drop_last,
+                         prefetch=prefetch, seed=seed)
+        self.max_degree = int(max_degree)
+
+    def __iter__(self) -> Iterator[Batch]:
+        pending = deque()
+        batches = self._epoch_seed_batches()
+        while True:
+            while len(pending) < self.prefetch:
+                seeds = next(batches, None)
+                if seeds is None:
+                    break
+                pending.append(
+                    (self.sampler.subgraph(NodeSamplerInput(seeds),
+                                           max_degree=self.max_degree),
+                     seeds.shape[0]))
+            if not pending:
+                return
+            out, nseeds = pending.popleft()
+            yield self._collate_fn(out, nseeds)

@@ -1,0 +1,135 @@
+"""Sampled-batch container + assembly — the ``to_data`` analog.
+
+Rebuild of the reference's ``loader/transform.py:25-104`` (``to_data`` /
+``to_hetero_data``): there, sampler output + gathered features become a PyG
+``Data``/``HeteroData``.  Here the product is :class:`Batch` — a registered
+pytree with static shapes, ready to feed a jitted flax model: padded COO
+``edge_index``, -1 sentinels, and explicit masks instead of ragged tensors.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..sampler.base import HeteroSamplerOutput, SamplerOutput
+from ..typing import EdgeType, NodeType
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Batch:
+    """One sampled ego-subgraph batch (the PyG ``Data`` analog).
+
+    * ``x``: ``[max_nodes, d]`` features for ``node`` (zeros on padding).
+    * ``y``: ``[max_nodes]`` labels (PADDING on padding rows).
+    * ``edge_index``: ``[2, max_edges]`` local COO, direction dst<-src
+      (row 0 = message source), -1 padded.
+    * ``edge_id``: ``[max_edges]`` global edge ids.
+    * ``node``: ``[max_nodes]`` global node ids; seeds occupy the first
+      ``batch_size`` slots (loader contract, node_loader.py:85).
+    * ``batch``: ``[batch_size]`` seed ids; ``batch_size`` is static.
+    """
+    x: Optional[jnp.ndarray]
+    y: Optional[jnp.ndarray]
+    edge_index: jnp.ndarray
+    edge_id: Optional[jnp.ndarray]
+    node: jnp.ndarray
+    node_mask: jnp.ndarray
+    edge_mask: jnp.ndarray
+    batch: Optional[jnp.ndarray]
+    batch_size: int = 0
+    edge_attr: Optional[jnp.ndarray] = None
+    metadata: Optional[Dict[str, Any]] = None
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.node.shape[0])
+
+    def tree_flatten(self):
+        children = (self.x, self.y, self.edge_index, self.edge_id, self.node,
+                    self.node_mask, self.edge_mask, self.batch,
+                    self.edge_attr, self.metadata)
+        return children, (self.batch_size,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        (x, y, edge_index, edge_id, node, node_mask, edge_mask, batch,
+         edge_attr, metadata) = children
+        return cls(x, y, edge_index, edge_id, node, node_mask, edge_mask,
+                   batch, aux[0], edge_attr, metadata)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class HeteroBatch:
+    """Heterogeneous batch (the PyG ``HeteroData`` analog): per-type dicts."""
+    x: Dict[NodeType, jnp.ndarray]
+    y: Optional[Dict[NodeType, jnp.ndarray]]
+    edge_index: Dict[EdgeType, jnp.ndarray]
+    edge_id: Dict[EdgeType, jnp.ndarray]
+    node: Dict[NodeType, jnp.ndarray]
+    node_mask: Dict[NodeType, jnp.ndarray]
+    edge_mask: Dict[EdgeType, jnp.ndarray]
+    batch: Optional[Dict[NodeType, jnp.ndarray]]
+    batch_size: int = 0
+    input_type: Optional[NodeType] = None
+    metadata: Optional[Dict[str, Any]] = None
+
+    def tree_flatten(self):
+        children = (self.x, self.y, self.edge_index, self.edge_id, self.node,
+                    self.node_mask, self.edge_mask, self.batch, self.metadata)
+        return children, (self.batch_size, self.input_type)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        (x, y, edge_index, edge_id, node, node_mask, edge_mask, batch,
+         metadata) = children
+        return cls(x, y, edge_index, edge_id, node, node_mask, edge_mask,
+                   batch, aux[0], aux[1], metadata)
+
+
+def to_batch(
+    out: SamplerOutput,
+    x: Optional[jnp.ndarray] = None,
+    y: Optional[jnp.ndarray] = None,
+    batch_size: int = 0,
+    edge_attr: Optional[jnp.ndarray] = None,
+) -> Batch:
+    """Assemble a :class:`Batch` from sampler output + gathered tensors.
+
+    Edge direction: ``SamplerOutput.row`` is already the neighbor
+    (message-source) side — the transpose happened in the sampler
+    (neighbor_sampler.py:159-165) — so ``edge_index[0] = row``.
+    """
+    return Batch(
+        x=x,
+        y=y,
+        edge_index=jnp.stack([out.row, out.col]),
+        edge_id=out.edge,
+        node=out.node,
+        node_mask=out.node_mask,
+        edge_mask=out.edge_mask,
+        batch=out.batch,
+        batch_size=batch_size,
+        edge_attr=edge_attr,
+        metadata=out.metadata,
+    )
+
+
+def to_hetero_batch(
+    out: HeteroSamplerOutput,
+    x: Optional[Dict[NodeType, jnp.ndarray]] = None,
+    y: Optional[Dict[NodeType, jnp.ndarray]] = None,
+    batch_size: int = 0,
+) -> HeteroBatch:
+    edge_index = {et: jnp.stack([out.row[et], out.col[et]])
+                  for et in out.row}
+    return HeteroBatch(
+        x=x or {}, y=y, edge_index=edge_index, edge_id=out.edge,
+        node=out.node, node_mask=out.node_mask, edge_mask=out.edge_mask,
+        batch=out.batch, batch_size=batch_size, input_type=out.input_type,
+        metadata=out.metadata,
+    )

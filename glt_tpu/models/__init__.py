@@ -1,0 +1,25 @@
+from .conv import GATConv, SAGEConv, scatter_mean, scatter_sum, segment_softmax
+from .gat import GAT
+from .sage import GraphSAGE
+from .train import (
+    TrainState,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+    seed_cross_entropy,
+)
+
+__all__ = [
+    "GAT",
+    "GATConv",
+    "GraphSAGE",
+    "SAGEConv",
+    "TrainState",
+    "create_train_state",
+    "make_eval_step",
+    "make_train_step",
+    "scatter_mean",
+    "scatter_sum",
+    "seed_cross_entropy",
+    "segment_softmax",
+]

@@ -1,0 +1,116 @@
+"""Graph convolution layers over padded COO batches, flax-native.
+
+The reference library ships no models (GNNs come from PyG; see SURVEY §0),
+but its sampled batches exist to feed SAGEConv/GATConv-style layers — so a
+complete TPU framework must provide them.  These layers consume
+:class:`~glt_tpu.loader.transform.Batch` tensors directly: ``[2, E]`` COO
+with -1 padding and an ``edge_mask``, ``edge_index[0]`` = message source
+(the sampler already transposed direction, neighbor_sampler.py:159-165).
+
+TPU notes: aggregation is ``jax.ops.segment_sum`` with a spill segment for
+padding edges (XLA lowers this to sorted-scatter, MXU-friendly); all matmuls
+are batched over the padded node dimension so shapes are static.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def scatter_sum(msgs: jnp.ndarray, dst: jnp.ndarray, num_nodes: int,
+                mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Sum messages into destination slots; -1/masked edges go to a spill row."""
+    if mask is None:
+        mask = dst >= 0
+    seg = jnp.where(mask, dst, num_nodes)
+    msgs = jnp.where(mask[:, None], msgs, 0)
+    return jax.ops.segment_sum(msgs, seg, num_segments=num_nodes + 1)[:num_nodes]
+
+
+def scatter_mean(msgs: jnp.ndarray, dst: jnp.ndarray, num_nodes: int,
+                 mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    if mask is None:
+        mask = dst >= 0
+    s = scatter_sum(msgs, dst, num_nodes, mask)
+    seg = jnp.where(mask, dst, num_nodes)
+    cnt = jax.ops.segment_sum(mask.astype(msgs.dtype), seg,
+                              num_segments=num_nodes + 1)[:num_nodes]
+    return s / jnp.maximum(cnt, 1)[:, None]
+
+
+def segment_softmax(scores: jnp.ndarray, seg: jnp.ndarray, num_segments: int,
+                    mask: jnp.ndarray) -> jnp.ndarray:
+    """Numerically-stable softmax over edges grouped by destination."""
+    seg_safe = jnp.where(mask, seg, num_segments)
+    smax = jax.ops.segment_max(jnp.where(mask, scores, -jnp.inf), seg_safe,
+                               num_segments=num_segments + 1)
+    smax = jnp.where(jnp.isfinite(smax), smax, 0)
+    ex = jnp.where(mask, jnp.exp(scores - smax[seg_safe]), 0)
+    denom = jax.ops.segment_sum(ex, seg_safe, num_segments=num_segments + 1)
+    return ex / jnp.maximum(denom[seg_safe], 1e-16)
+
+
+class SAGEConv(nn.Module):
+    """GraphSAGE convolution (mean aggregator).
+
+    ``h_i = W_self x_i + W_nbr mean_{j->i} x_j``
+    """
+    out_features: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, edge_index, edge_mask):
+        num_nodes = x.shape[0]
+        src, dst = edge_index[0], edge_index[1]
+        msgs = jnp.take(x, jnp.clip(src, 0, num_nodes - 1), axis=0)
+        agg = scatter_mean(msgs, dst, num_nodes, edge_mask)
+        out = (nn.Dense(self.out_features, use_bias=self.use_bias,
+                        name="lin_self")(x)
+               + nn.Dense(self.out_features, use_bias=False,
+                          name="lin_nbr")(agg))
+        return out
+
+
+class GATConv(nn.Module):
+    """Graph attention convolution (GATv1, multi-head, concat)."""
+    out_features: int
+    heads: int = 1
+    concat: bool = True
+    negative_slope: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, edge_index, edge_mask):
+        num_nodes = x.shape[0]
+        h, f = self.heads, self.out_features
+        src, dst = edge_index[0], edge_index[1]
+        src_c = jnp.clip(src, 0, num_nodes - 1)
+        dst_c = jnp.clip(dst, 0, num_nodes - 1)
+
+        z = nn.Dense(h * f, use_bias=False, name="lin")(x).reshape(
+            num_nodes, h, f)
+        att_src = self.param("att_src", nn.initializers.glorot_uniform(),
+                             (h, f))
+        att_dst = self.param("att_dst", nn.initializers.glorot_uniform(),
+                             (h, f))
+        alpha_src = (z * att_src).sum(-1)   # [N, h]
+        alpha_dst = (z * att_dst).sum(-1)
+
+        e = alpha_src[src_c] + alpha_dst[dst_c]          # [E, h]
+        e = nn.leaky_relu(e, self.negative_slope)
+        # Per-head softmax over incoming edges of each destination.
+        alpha = jax.vmap(
+            lambda s: segment_softmax(s, dst, num_nodes, edge_mask),
+            in_axes=1, out_axes=1)(e)                    # [E, h]
+        msgs = z[src_c] * alpha[:, :, None]              # [E, h, f]
+        out = scatter_sum(msgs.reshape(-1, h * f), dst, num_nodes,
+                          edge_mask).reshape(num_nodes, h, f)
+        if self.concat:
+            out = out.reshape(num_nodes, h * f)
+        else:
+            out = out.mean(axis=1)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (out.shape[-1],))
+        return out + bias

@@ -1,0 +1,28 @@
+"""GAT stack over padded batches."""
+from __future__ import annotations
+
+from flax import linen as nn
+
+from .conv import GATConv
+
+
+class GAT(nn.Module):
+    hidden_features: int
+    out_features: int
+    num_layers: int = 2
+    heads: int = 4
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, edge_index, edge_mask, *, train: bool = False):
+        for i in range(self.num_layers):
+            last = i == self.num_layers - 1
+            if last:
+                x = GATConv(self.out_features, heads=1, concat=False,
+                            name=f"conv{i}")(x, edge_index, edge_mask)
+            else:
+                x = GATConv(self.hidden_features, heads=self.heads,
+                            name=f"conv{i}")(x, edge_index, edge_mask)
+                x = nn.elu(x)
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return x

@@ -1,0 +1,77 @@
+"""Jitted supervised train/eval steps for sampled batches.
+
+The reference leaves training loops to user PyTorch code
+(examples/train_sage_ogbn_products.py); here the train step is part of the
+framework so the whole batch -> loss -> grad -> update path is one XLA
+program.  Loss is masked cross-entropy over the **seed rows only** — seeds
+occupy ``node[:batch_size]`` by the sampler's first-occurrence contract.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def create_train_state(model, rng, sample_batch, tx) -> TrainState:
+    params = model.init({"params": rng}, sample_batch.x,
+                        sample_batch.edge_index, sample_batch.edge_mask)
+    return TrainState(params=params, opt_state=tx.init(params),
+                      step=jnp.zeros((), jnp.int32))
+
+
+def seed_cross_entropy(logits, y, batch_size: int, node_mask):
+    """Mean CE over valid seed rows (first ``batch_size`` slots)."""
+    sl = logits[:batch_size]
+    sy = y[:batch_size]
+    valid = (sy >= 0) & node_mask[:batch_size]
+    sy_safe = jnp.where(valid, sy, 0)
+    ce = optax.softmax_cross_entropy_with_integer_labels(sl, sy_safe)
+    n = jnp.maximum(valid.sum(), 1)
+    loss = jnp.where(valid, ce, 0).sum() / n
+    acc = jnp.where(valid, jnp.argmax(sl, -1) == sy_safe, False).sum() / n
+    return loss, acc
+
+
+def make_train_step(model, tx, batch_size: int,
+                    dropout_seed: int = 0) -> Callable:
+    """Build a jitted ``(state, batch) -> (state, loss, acc)`` step."""
+
+    @jax.jit
+    def train_step(state: TrainState, batch):
+        rng = jax.random.fold_in(jax.random.PRNGKey(dropout_seed), state.step)
+
+        def loss_fn(params):
+            logits = model.apply(params, batch.x, batch.edge_index,
+                                 batch.edge_mask, train=True,
+                                 rngs={"dropout": rng})
+            return seed_cross_entropy(logits, batch.y, batch_size,
+                                      batch.node_mask)
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss, acc
+
+    return train_step
+
+
+def make_eval_step(model, batch_size: int) -> Callable:
+    @jax.jit
+    def eval_step(params, batch):
+        logits = model.apply(params, batch.x, batch.edge_index,
+                             batch.edge_mask, train=False)
+        return seed_cross_entropy(logits, batch.y, batch_size,
+                                  batch.node_mask)
+
+    return eval_step
