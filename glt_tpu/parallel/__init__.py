@@ -1,0 +1,13 @@
+from .sharding import ShardedGraph, ShardedFeature, shard_graph, shard_feature
+from .dist_sampler import DistNeighborSampler, exchange_one_hop
+from .dist_feature import exchange_gather
+
+__all__ = [
+    "DistNeighborSampler",
+    "ShardedFeature",
+    "ShardedGraph",
+    "exchange_gather",
+    "exchange_one_hop",
+    "shard_feature",
+    "shard_graph",
+]

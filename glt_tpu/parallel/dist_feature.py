@@ -1,0 +1,55 @@
+"""Distributed feature lookup: all-to-all row exchange inside shard_map.
+
+TPU-native replacement for ``distributed/dist_feature.py:122-269``: the
+reference masks ids through the feature partition book, gathers local rows
+from the UnifiedTensor, issues per-remote-partition async RPCs
+(``RpcFeatureLookupCallee``) and scatter-stitches responses into the output
+buffer.  Here the whole lookup is one collective round-trip: bucket ids by
+owner shard, ``all_to_all`` the id buckets, every shard gathers its rows
+from HBM, ``all_to_all`` the row blocks back, unscatter.  Payload rides ICI
+and overlaps with neighboring compute under XLA's scheduler.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .dist_sampler import _bucket_by_owner
+
+
+def exchange_gather(
+    ids: jnp.ndarray,
+    rows: jnp.ndarray,
+    nodes_per_shard: int,
+    num_shards: int,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Gather feature rows for global ``ids`` across shards.
+
+    Call inside ``shard_map``. Args:
+      ids: ``[B]`` global node ids on this shard (-1 padded -> zero rows).
+      rows: ``[nodes_per_shard, d]`` this shard's feature block.
+
+    Returns: ``[B, d]`` rows in input order.
+    """
+    b = ids.shape[0]
+    d = rows.shape[-1]
+    owner = jnp.where(ids >= 0, ids // nodes_per_shard, -1)
+    routing = _bucket_by_owner(ids, owner, num_shards, cap=b)
+
+    requests = lax.all_to_all(
+        routing.buckets.reshape(num_shards, b), axis_name, 0, 0,
+        tiled=False).reshape(num_shards * b)
+
+    my_rank = lax.axis_index(axis_name)
+    local = requests - my_rank * nodes_per_shard
+    ok = (local >= 0) & (local < nodes_per_shard) & (requests >= 0)
+    got = jnp.take(rows, jnp.where(ok, local, 0), axis=0, mode="clip")
+    got = jnp.where(ok[:, None], got, 0)
+
+    resp = lax.all_to_all(
+        got.reshape(num_shards, b, d), axis_name, 0, 0,
+        tiled=False).reshape(num_shards * b, d)
+    out = resp[jnp.clip(routing.slot, 0, num_shards * b - 1)]
+    return jnp.where(routing.valid[:, None], out, 0)

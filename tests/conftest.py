@@ -7,14 +7,26 @@ for a TPU slice.  Must run before the first jax import.
 import os
 import sys
 
-# Force CPU: the ambient environment points JAX_PLATFORMS at the real TPU
-# tunnel (axon), which is reserved for benchmarking — tests always run on
-# the virtual device mesh.
+# Force CPU: the ambient environment points JAX at the real TPU tunnel
+# (axon), which is reserved for benchmarking — tests always run on the
+# virtual device mesh.  The axon sitecustomize hook sets
+# jax.config.jax_platforms = "axon,cpu" at interpreter start, which takes
+# precedence over the env var, so override the config value directly.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+if _xb.backends_are_initialized():  # a fixture touched jax before us
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
