@@ -1,0 +1,104 @@
+"""Headline benchmark: neighbor-sampling throughput (sampled edges/sec).
+
+Metric definition follows the reference's ``benchmarks/api/bench_sampler.py``
+(:27-54): multi-hop neighbor sampling with fanout [15, 10, 5], batch 1024,
+on an ogbn-products-scale graph, reporting "Sampled Edges per sec (M)".
+The reference publishes no absolute numbers (BASELINE.md) — ``BASELINE_M``
+below is an *estimate* of the reference's single-A100 result for this exact
+config, used only to populate ``vs_baseline``.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Run on the real TPU chip (ambient JAX_PLATFORMS=axon); falls back to
+whatever backend is available.  GLT_BENCH_SCALE=small shrinks the graph for
+smoke tests.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Estimated single-A100 sampled-edges/sec (M) for GLT's CUDA sampler at
+# fanout [15,10,5], batch 1024 on ogbn-products (no published number exists;
+# see BASELINE.md).
+BASELINE_M = 180.0
+
+FANOUT = [15, 10, 5]
+BATCH = 1024
+WARMUP = 3
+ITERS = 20
+
+
+def build_products_scale_graph(small: bool):
+    """Synthetic graph at ogbn-products scale: 2.45M nodes, avg degree 25.
+
+    Built directly in CSR (fixed degree, uniform neighbors) so construction
+    is O(E) with no sort; the sampler's access pattern (random CSR row
+    reads) matches the real dataset's hot loop.
+    """
+    if small:
+        n, deg = 20_000, 10
+    else:
+        n, deg = 2_449_029, 25
+    rng = np.random.default_rng(0)
+    indptr = (np.arange(n + 1, dtype=np.int64) * deg).astype(np.int32)
+    indices = rng.integers(0, n, n * deg, dtype=np.int32)
+    return n, indptr, indices
+
+
+def main():
+    small = os.environ.get("GLT_BENCH_SCALE") == "small"
+    import jax
+    import jax.numpy as jnp
+
+    from glt_tpu.sampler.neighbor_sampler import NeighborSampler
+    from glt_tpu.sampler.base import NodeSamplerInput
+    from glt_tpu.data.graph import Graph
+    from glt_tpu.data.topology import CSRTopo
+
+    n, indptr, indices = build_products_scale_graph(small)
+
+    # Bypass CSRTopo's COO round-trip: install CSR arrays directly.
+    topo = CSRTopo.__new__(CSRTopo)
+    topo._indptr = indptr
+    topo._indices = indices
+    topo._edge_ids = np.arange(indices.shape[0], dtype=np.int32)
+    topo._edge_weights = None
+    graph = Graph(topo, mode="DEVICE")
+
+    sampler = NeighborSampler(graph, FANOUT, batch_size=BATCH, seed=0)
+    rng = np.random.default_rng(1)
+    seed_batches = [rng.integers(0, n, BATCH, dtype=np.int64)
+                    for _ in range(WARMUP + ITERS)]
+
+    outs = []
+    for i in range(WARMUP):
+        out = sampler.sample_from_nodes(NodeSamplerInput(seed_batches[i]))
+        jax.block_until_ready(out.num_sampled_edges)
+
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        out = sampler.sample_from_nodes(
+            NodeSamplerInput(seed_batches[WARMUP + i]))
+        outs.append(out.num_sampled_edges)
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+
+    total_edges = float(sum(int(np.asarray(o).sum()) for o in outs))
+    edges_per_sec_m = total_edges / dt / 1e6
+
+    print(json.dumps({
+        "metric": "neighbor_sampling_throughput_f15_10_5_b1024",
+        "value": round(edges_per_sec_m, 3),
+        "unit": "M sampled edges/s",
+        "vs_baseline": round(edges_per_sec_m / BASELINE_M, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,13 +1,21 @@
 from .sharding import ShardedGraph, ShardedFeature, shard_graph, shard_feature
-from .dist_sampler import DistNeighborSampler, exchange_one_hop
+from .dist_sampler import (
+    DistNeighborSampler,
+    dist_sample_multi_hop,
+    exchange_one_hop,
+)
 from .dist_feature import exchange_gather
+from .dist_train import init_dist_state, make_dist_train_step
 
 __all__ = [
     "DistNeighborSampler",
     "ShardedFeature",
     "ShardedGraph",
+    "dist_sample_multi_hop",
     "exchange_gather",
     "exchange_one_hop",
+    "init_dist_state",
+    "make_dist_train_step",
     "shard_feature",
     "shard_graph",
 ]

@@ -128,6 +128,91 @@ def exchange_one_hop(
     return nbrs, eids, nbrs >= 0
 
 
+def dist_sample_multi_hop(
+    indptr: jnp.ndarray,
+    indices: jnp.ndarray,
+    edge_ids: jnp.ndarray,
+    seeds: jnp.ndarray,
+    key: jax.Array,
+    num_neighbors: Sequence[int],
+    nodes_per_shard: int,
+    num_shards: int,
+    axis_name: str,
+    frontier_cap: Optional[int] = None,
+) -> SamplerOutput:
+    """Per-shard multi-hop sampling body; call inside ``shard_map``.
+
+    Identical structure to the single-device
+    ``NeighborSampler._sample_impl`` — frontier, cumulative
+    first-occurrence dedup, relabeled COO — with
+    :func:`exchange_one_hop` as the one-hop primitive.
+    """
+    fanouts = list(num_neighbors)
+    widths = hop_widths(seeds.shape[0], fanouts, frontier_cap)
+    cap = max_sampled_nodes(seeds.shape[0], fanouts, frontier_cap)
+
+    u0 = unique_first_occurrence(seeds)
+    node_buf = jnp.full((cap,), PADDING_ID, jnp.int32)
+    node_buf = node_buf.at[: widths[0]].set(u0.uniques)
+    count = u0.count
+    frontier = u0.uniques
+    frontier_start = jnp.zeros((), jnp.int32)
+
+    rows, cols, eids_out, emasks = [], [], [], []
+    counts_per_hop = [count]
+    edges_per_hop = []
+    keys = jax.random.split(key, len(fanouts))
+
+    for i, f in enumerate(fanouts):
+        w = widths[i]
+        nbrs, eids, mask = exchange_one_hop(
+            frontier, indptr, indices, edge_ids, nodes_per_shard,
+            num_shards, f, keys[i], axis_name)
+
+        src_local = frontier_start + jnp.arange(w, dtype=jnp.int32)
+        src_local = jnp.where(frontier >= 0, src_local, PADDING_ID)
+
+        merged = unique_first_occurrence(
+            jnp.concatenate([node_buf, nbrs.ravel()]))
+        new_buf = merged.uniques
+        nbr_local = merged.inverse[cap:].reshape(w, f)
+        nbr_local = jnp.where(mask, nbr_local, PADDING_ID)
+
+        rows.append(nbr_local.ravel())
+        cols.append(jnp.broadcast_to(src_local[:, None], (w, f)).ravel())
+        eids_out.append(eids.ravel())
+        emasks.append(mask.ravel())
+        edges_per_hop.append(jnp.sum(mask.astype(jnp.int32)))
+
+        new_count = merged.count
+        if i + 1 < len(fanouts):
+            nw = widths[i + 1]
+            frontier = lax.dynamic_slice(
+                jnp.concatenate(
+                    [new_buf, jnp.full((nw,), PADDING_ID, jnp.int32)]),
+                (jnp.clip(count, 0, new_buf.shape[0]),), (nw,))
+            frontier_start = count
+        node_buf = new_buf[:cap]
+        count = jnp.minimum(new_count, cap)
+        counts_per_hop.append(count)
+
+    num_sampled_nodes = jnp.stack(
+        [counts_per_hop[0]]
+        + [counts_per_hop[i + 1] - counts_per_hop[i]
+           for i in range(len(fanouts))])
+    return SamplerOutput(
+        node=node_buf,
+        row=jnp.concatenate(rows),
+        col=jnp.concatenate(cols),
+        edge=jnp.concatenate(eids_out),
+        batch=seeds,
+        node_mask=jnp.arange(cap, dtype=jnp.int32) < count,
+        edge_mask=jnp.concatenate(emasks),
+        num_sampled_nodes=num_sampled_nodes,
+        num_sampled_edges=jnp.stack(edges_per_hop),
+    )
+
+
 class DistNeighborSampler:
     """Multi-hop distributed sampler over a :class:`ShardedGraph`.
 
@@ -177,78 +262,11 @@ class DistNeighborSampler:
     def _sample_local(self, indptr_blk, indices_blk, eids_blk, seeds_blk,
                       key):
         """Per-shard body (shapes carry a leading singleton shard axis)."""
-        indptr = indptr_blk[0]
-        indices = indices_blk[0]
-        edge_ids = eids_blk[0]
-        seeds = seeds_blk[0]
         key = jax.random.fold_in(key, lax.axis_index(self.axis_name))
-
-        fanouts = self.num_neighbors
-        widths = self._widths
-        cap = self.node_capacity
-
-        u0 = unique_first_occurrence(seeds)
-        node_buf = jnp.full((cap,), PADDING_ID, jnp.int32)
-        node_buf = node_buf.at[: widths[0]].set(u0.uniques)
-        count = u0.count
-        frontier = u0.uniques
-        frontier_start = jnp.zeros((), jnp.int32)
-
-        rows, cols, eids_out, emasks = [], [], [], []
-        counts_per_hop = [count]
-        edges_per_hop = []
-        keys = jax.random.split(key, len(fanouts))
-
-        for i, f in enumerate(fanouts):
-            w = widths[i]
-            nbrs, eids, mask = exchange_one_hop(
-                frontier, indptr, indices, edge_ids,
-                self.g.nodes_per_shard, self.g.num_shards, f, keys[i],
-                self.axis_name)
-
-            src_local = frontier_start + jnp.arange(w, dtype=jnp.int32)
-            src_local = jnp.where(frontier >= 0, src_local, PADDING_ID)
-
-            cand = nbrs.ravel()
-            merged = unique_first_occurrence(
-                jnp.concatenate([node_buf, cand]))
-            new_buf = merged.uniques
-            nbr_local = merged.inverse[cap:].reshape(w, f)
-            nbr_local = jnp.where(mask, nbr_local, PADDING_ID)
-
-            rows.append(nbr_local.ravel())
-            cols.append(jnp.broadcast_to(src_local[:, None], (w, f)).ravel())
-            eids_out.append(eids.ravel())
-            emasks.append(mask.ravel())
-            edges_per_hop.append(jnp.sum(mask.astype(jnp.int32)))
-
-            new_count = merged.count
-            if i + 1 < len(fanouts):
-                nw = widths[i + 1]
-                frontier = lax.dynamic_slice(
-                    jnp.concatenate(
-                        [new_buf, jnp.full((nw,), PADDING_ID, jnp.int32)]),
-                    (jnp.clip(count, 0, new_buf.shape[0]),), (nw,))
-                frontier_start = count
-            node_buf = new_buf[:cap]
-            count = jnp.minimum(new_count, cap)
-            counts_per_hop.append(count)
-
-        num_sampled_nodes = jnp.stack(
-            [counts_per_hop[0]]
-            + [counts_per_hop[i + 1] - counts_per_hop[i]
-               for i in range(len(fanouts))])
-        out = SamplerOutput(
-            node=node_buf,
-            row=jnp.concatenate(rows),
-            col=jnp.concatenate(cols),
-            edge=jnp.concatenate(eids_out),
-            batch=seeds,
-            node_mask=jnp.arange(cap, dtype=jnp.int32) < count,
-            edge_mask=jnp.concatenate(emasks),
-            num_sampled_nodes=num_sampled_nodes,
-            num_sampled_edges=jnp.stack(edges_per_hop),
-        )
+        out = dist_sample_multi_hop(
+            indptr_blk[0], indices_blk[0], eids_blk[0], seeds_blk[0], key,
+            self.num_neighbors, self.g.nodes_per_shard, self.g.num_shards,
+            self.axis_name, self.frontier_cap)
         # Re-add the shard axis for shard_map's out_specs.
         return jax.tree.map(lambda x: x[None], out)
 

@@ -1,0 +1,112 @@
+"""Fully-fused distributed training step: sample + gather + SGD in one jit.
+
+The reference's distributed training loop spans four process fleets —
+sampling workers, shm channels, RPC feature servers, and DDP trainers
+(SURVEY §3.2).  On TPU the entire iteration is **one XLA program over the
+mesh**: per-shard all-to-all neighbor sampling
+(:func:`~glt_tpu.parallel.dist_sampler.dist_sample_multi_hop`), all-to-all
+feature/label gather (:func:`~glt_tpu.parallel.dist_feature.exchange_gather`),
+model forward/backward, and a gradient ``pmean`` (the NCCL-allreduce analog,
+examples/distributed/dist_train_sage_supervised.py:52-58).  Each mesh device
+plays both roles of the reference's collocated mode (dist_loader.py:142-186):
+graph-shard owner and data-parallel trainer.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.train import TrainState, seed_cross_entropy
+from ..typing import PADDING_ID
+from .dist_feature import exchange_gather
+from .dist_sampler import dist_sample_multi_hop
+from .sharding import ShardedFeature, ShardedGraph
+
+
+def make_dist_train_step(
+    model,
+    tx,
+    g: ShardedGraph,
+    f: ShardedFeature,
+    labels: jnp.ndarray,          # [S, nodes_per_shard] int labels
+    mesh: Mesh,
+    num_neighbors: Sequence[int],
+    batch_size: int,
+    axis_name: str = "shard",
+    frontier_cap: Optional[int] = None,
+):
+    """Build ``step(state, seeds [S, B], key) -> (state, loss, acc)``.
+
+    ``seeds`` carries one seed batch per shard (the per-rank disjoint seed
+    split of dist_train_sage_supervised.py:76); params/opt state are
+    replicated; gradients are ``pmean``-ed across the mesh.
+    """
+    gspec = P(axis_name)
+
+    def local_body(indptr, indices, edge_ids, rows, labels_blk, seeds,
+                   params, key):
+        indptr, indices, edge_ids = indptr[0], indices[0], edge_ids[0]
+        rows, labels_blk, seeds = rows[0], labels_blk[0], seeds[0]
+        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+
+        out = dist_sample_multi_hop(
+            indptr, indices, edge_ids, seeds, key, num_neighbors,
+            g.nodes_per_shard, g.num_shards, axis_name, frontier_cap)
+        x = exchange_gather(out.node, rows, f.nodes_per_shard,
+                            f.num_shards, axis_name)
+        y = exchange_gather(out.node, labels_blk[:, None].astype(jnp.int32),
+                            g.nodes_per_shard, g.num_shards, axis_name)[:, 0]
+        y = jnp.where(out.node >= 0, y, PADDING_ID)
+        edge_index = jnp.stack([out.row, out.col])
+
+        def loss_fn(p):
+            logits = model.apply(p, x, edge_index, out.edge_mask,
+                                 train=True, rngs={"dropout": key})
+            return seed_cross_entropy(logits, y, batch_size, out.node_mask)
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params)
+        grads = lax.pmean(grads, axis_name)
+        loss = lax.pmean(loss, axis_name)
+        acc = lax.pmean(acc, axis_name)
+        return loss, acc, grads
+
+    shard_fn = jax.shard_map(
+        local_body, mesh=mesh,
+        in_specs=(gspec, gspec, gspec, gspec, gspec, gspec, P(), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False)
+
+    @jax.jit
+    def step(state: TrainState, seeds: jnp.ndarray, key: jax.Array):
+        loss, acc, grads = shard_fn(g.indptr, g.indices, g.edge_ids,
+                                    f.rows, labels, seeds, state.params, key)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss, acc
+
+    return step
+
+
+def init_dist_state(model, tx, g: ShardedGraph, f: ShardedFeature,
+                    rng: jax.Array, num_neighbors: Sequence[int],
+                    batch_size: int) -> TrainState:
+    """Initialize replicated params/opt-state with correctly-shaped dummies."""
+    from ..sampler.neighbor_sampler import hop_widths, max_sampled_nodes
+
+    cap = max_sampled_nodes(batch_size, list(num_neighbors))
+    widths = hop_widths(batch_size, list(num_neighbors))
+    ecap = sum(w * fo for w, fo in zip(widths, num_neighbors))
+
+    x = jnp.zeros((cap, f.rows.shape[-1]), f.rows.dtype)
+    ei = jnp.full((2, ecap), PADDING_ID, jnp.int32)
+    mask = jnp.zeros((ecap,), bool)
+    params = model.init({"params": rng}, x, ei, mask)
+    return TrainState(params=params, opt_state=tx.init(params),
+                      step=jnp.zeros((), jnp.int32))
