@@ -1,0 +1,21 @@
+from .base import PartitionerBase, cat_feature_cache, load_partition
+from .contiguous import (
+    ContiguousRelabel,
+    contiguous_relabel,
+    relabel_rows,
+    relabel_topology,
+)
+from .frequency_partitioner import FrequencyPartitioner
+from .random_partitioner import RandomPartitioner
+
+__all__ = [
+    "ContiguousRelabel",
+    "FrequencyPartitioner",
+    "PartitionerBase",
+    "RandomPartitioner",
+    "cat_feature_cache",
+    "contiguous_relabel",
+    "load_partition",
+    "relabel_rows",
+    "relabel_topology",
+]

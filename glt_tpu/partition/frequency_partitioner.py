@@ -1,0 +1,75 @@
+"""Hotness-aware frequency partitioner.
+
+Rebuild of ``partition/frequency_partitioner.py``: each training rank
+supplies a per-node access-probability vector (from
+``NeighborSampler.sample_prob`` over its seed set); node chunks are greedily
+assigned to the partition where they are hottest relative to the others
+(``_get_chunk_probs_sum`` / ``_partition_node``, frequency_partitioner.py:
+96-170), under a balance cap; each partition then hot-caches the most
+frequently accessed *remote* nodes under a cache budget (``_cache_node``,
+:171+).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .base import PartitionerBase
+
+
+class FrequencyPartitioner(PartitionerBase):
+    """Args beyond :class:`PartitionerBase`:
+
+    probs: per-partition ``[num_nodes]`` access-probability vectors (one
+      per training rank, ``len(probs) == num_parts``).
+    cache_ratio: fraction of nodes each partition may hot-cache.
+    balance_cap: max fraction above perfect balance a partition may own.
+    """
+
+    def __init__(self, *args, probs: Sequence[np.ndarray],
+                 cache_ratio: float = 0.0, balance_cap: float = 1.05,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        assert len(probs) == self.num_parts, \
+            "need one probability vector per partition"
+        self.probs = [np.asarray(p, np.float64) for p in probs]
+        self.cache_ratio = float(cache_ratio)
+        self.balance_cap = float(balance_cap)
+
+    def _partition_node(self) -> np.ndarray:
+        n, k = self.num_nodes, self.num_parts
+        cap = int(np.ceil(n / k * self.balance_cap))
+        node_pb = np.full(n, -1, np.int32)
+        counts = np.zeros(k, np.int64)
+
+        for lo in range(0, n, self.chunk_size):
+            hi = min(lo + self.chunk_size, n)
+            # score[p] = own hotness * k - everyone's hotness
+            # (frequency_partitioner.py:96-120)
+            chunk_probs = np.stack([p[lo:hi].sum() for p in self.probs])
+            score = chunk_probs * k - chunk_probs.sum()
+            order = np.argsort(-score)
+            for p in order:
+                if counts[p] + (hi - lo) <= cap:
+                    node_pb[lo:hi] = p
+                    counts[p] += hi - lo
+                    break
+            else:  # all at cap: least-loaded
+                p = int(np.argmin(counts))
+                node_pb[lo:hi] = p
+                counts[p] += hi - lo
+        return node_pb
+
+    def _cache_node(self, node_pb: np.ndarray) -> List[np.ndarray]:
+        budget = int(self.num_nodes * self.cache_ratio)
+        out = []
+        for p in range(self.num_parts):
+            if budget == 0:
+                out.append(np.empty(0, np.int64))
+                continue
+            prob = self.probs[p].copy()
+            prob[node_pb == p] = -1.0  # only remote nodes are worth caching
+            hot = np.argsort(-prob)[:budget]
+            out.append(hot[prob[hot] > 0].astype(np.int64))
+        return out

@@ -312,6 +312,40 @@ class NeighborSampler(BaseSampler):
         out.metadata = meta
         return out
 
+    # -- hotness estimation (cf. neighbor_sampler.py:435-562 sample_prob,
+    #    CalNbrProb kernel random_sampler.cu:168-209) ----------------------
+    def sample_prob(self, seed_ids: np.ndarray, node_count: int) -> jnp.ndarray:
+        """Per-node probability of being touched by sampling from ``seeds``.
+
+        One full-graph sparse propagation per hop: an edge ``u -> v``
+        contributes ``p_u * min(fanout / deg_u, 1)`` to ``p_v`` (exactly the
+        per-edge weight the CUDA ``CalNbrProb`` kernel applies); hop results
+        are union-bounded into a cumulative visit probability.  Used by the
+        frequency partitioner's hotness scores.
+        """
+        g = self.graph
+        indptr, indices = g.indptr, g.indices
+        num_nodes = int(indptr.shape[0]) - 1
+        edge_src = jnp.searchsorted(
+            indptr, jnp.arange(indices.shape[0], dtype=indptr.dtype),
+            side="right").astype(jnp.int32) - 1
+        deg = (indptr[1:] - indptr[:-1]).astype(jnp.float32)
+
+        prob = jnp.zeros((num_nodes,), jnp.float32)
+        prob = prob.at[jnp.asarray(seed_ids, jnp.int32)].set(1.0)
+        total = prob
+        for f in self.num_neighbors:
+            w = jnp.minimum(f / jnp.maximum(deg, 1.0), 1.0)
+            contrib = prob[edge_src] * w[edge_src]
+            nxt = jax.ops.segment_sum(contrib, indices,
+                                      num_segments=num_nodes)
+            prob = jnp.minimum(nxt, 1.0)
+            total = jnp.minimum(total + prob, 1.0)
+        if node_count > num_nodes:
+            total = jnp.concatenate(
+                [total, jnp.zeros((node_count - num_nodes,), jnp.float32)])
+        return total
+
     # -- induced subgraph (cf. neighbor_sampler.py:409-433) ---------------
     def subgraph(self, inputs: NodeSamplerInput, max_degree: int = 64,
                  key: Optional[jax.Array] = None) -> SamplerOutput:

@@ -1,0 +1,139 @@
+"""Partitioning tests (cf. test/python/test_partition.py): save/load
+round-trip, frequency assignment honoring hotness, cache merge, and the
+contiguous-relabel bridge into mesh sharding."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from glt_tpu.data import CSRTopo, Graph
+from glt_tpu.partition import (
+    FrequencyPartitioner,
+    RandomPartitioner,
+    cat_feature_cache,
+    contiguous_relabel,
+    load_partition,
+    relabel_rows,
+    relabel_topology,
+)
+from glt_tpu.sampler import NeighborSampler
+
+
+def ring(n):
+    src = np.repeat(np.arange(n), 2)
+    dst = np.concatenate([[(i + 1) % n, (i + 2) % n] for i in range(n)])
+    return np.stack([src, dst])
+
+
+class TestRandomPartitioner:
+    def test_roundtrip(self, tmp_path):
+        n = 40
+        ei = ring(n)
+        feat = np.arange(n, dtype=np.float32)[:, None]
+        part = RandomPartitioner(str(tmp_path), 4, n, ei, node_feat=feat,
+                                 chunk_size=8)
+        part.partition()
+
+        all_nodes, all_edges = [], 0
+        for p in range(4):
+            graph, node_feat, _, node_pb, edge_pb, meta = load_partition(
+                str(tmp_path), p)
+            assert meta["num_parts"] == 4
+            # every owned edge's src belongs to this partition (by_src)
+            assert (node_pb[graph.edge_index[0]] == p).all()
+            # features match global ids
+            np.testing.assert_array_equal(node_feat.feats[:, 0],
+                                          node_feat.ids)
+            all_nodes.extend(node_feat.ids.tolist())
+            all_edges += graph.eids.shape[0]
+        assert sorted(all_nodes) == list(range(n))
+        assert all_edges == ei.shape[1]
+
+    def test_balanced(self, tmp_path):
+        part = RandomPartitioner(str(tmp_path), 4, 40, ring(40))
+        pb = part._partition_node()
+        assert np.bincount(pb).max() - np.bincount(pb).min() <= 1
+
+
+class TestFrequencyPartitioner:
+    def test_hotness_assignment(self, tmp_path):
+        n, k = 40, 2
+        # rank 0 is hot on nodes < 20, rank 1 on nodes >= 20
+        probs = [np.where(np.arange(n) < 20, 1.0, 0.0),
+                 np.where(np.arange(n) >= 20, 1.0, 0.0)]
+        part = FrequencyPartitioner(str(tmp_path), k, n, ring(n),
+                                    probs=probs, chunk_size=10,
+                                    cache_ratio=0.1)
+        pb = part._partition_node()
+        assert (pb[:20] == 0).all()
+        assert (pb[20:] == 1).all()
+
+    def test_cache_remote_hot(self, tmp_path):
+        n, k = 40, 2
+        probs = [np.where(np.arange(n) < 20, 1.0, 0.01),
+                 np.where(np.arange(n) >= 20, 1.0, 0.01)]
+        part = FrequencyPartitioner(str(tmp_path), k, n, ring(n),
+                                    probs=probs, chunk_size=10,
+                                    cache_ratio=0.1)
+        pb = part._partition_node()
+        caches = part._cache_node(pb)
+        for p, cache in enumerate(caches):
+            assert len(cache) > 0
+            assert (pb[cache] != p).all()  # only remote nodes cached
+
+    def test_sample_prob_hotness(self):
+        n = 30
+        topo = CSRTopo(ring(n), num_nodes=n)
+        g = Graph(topo, mode="HOST")
+        s = NeighborSampler(g, [2, 2], batch_size=4)
+        prob = np.asarray(s.sample_prob(np.array([0, 1]), n))
+        assert prob[0] == 1.0 and prob[1] == 1.0
+        # reachable-from-seeds nodes are hot, far nodes are cold
+        assert prob[2] > 0 and prob[3] > 0
+        assert prob[15] == 0.0
+
+
+class TestCatFeatureCache:
+    def test_merge(self, tmp_path):
+        n = 20
+        feat = np.arange(n, dtype=np.float32)[:, None]
+        probs = [np.ones(n), np.ones(n)]
+        part = FrequencyPartitioner(str(tmp_path), 2, n, ring(n),
+                                    probs=probs, node_feat=feat,
+                                    chunk_size=5, cache_ratio=0.2)
+        part.partition()
+        _, node_feat, _, node_pb, _, _ = load_partition(str(tmp_path), 0)
+        feats, id2index = cat_feature_cache(node_feat, n)
+        # every owned or cached id resolves locally and to the right row
+        for gid in np.concatenate([node_feat.ids, node_feat.cache_ids]):
+            assert id2index[gid] >= 0
+            assert feats[id2index[gid], 0] == gid
+
+
+class TestContiguous:
+    def test_relabel_and_shard(self):
+        from glt_tpu.parallel import shard_graph
+        n = 24
+        node_pb = (np.arange(n) * 7 % 3).astype(np.int32)  # scattered parts
+        rel = contiguous_relabel(node_pb)
+        topo = CSRTopo(ring(n), num_nodes=n)
+        new_topo = relabel_topology(topo, rel)
+        sg = shard_graph(new_topo, rel.num_parts)
+        assert sg.nodes_per_shard == rel.nodes_per_shard
+        ip, ix = np.asarray(sg.indptr), np.asarray(sg.indices)
+        # check edges of a few original nodes survive the relabel
+        for old in [0, 5, 23]:
+            new = rel.old2new[old]
+            s, v = divmod(new, rel.nodes_per_shard)
+            lo, hi = ip[s, v], ip[s, v + 1]
+            nbrs = {rel.new2old[x] for x in ix[s, lo:hi]}
+            assert nbrs == {(old + 1) % n, (old + 2) % n}
+        # owner arithmetic equals the original partition book
+        assert (rel.old2new // rel.nodes_per_shard == node_pb).all()
+
+    def test_relabel_rows(self):
+        node_pb = np.array([1, 0, 1, 0])
+        rel = contiguous_relabel(node_pb)
+        rows = np.array([[10.], [20.], [30.], [40.]])
+        out = relabel_rows(rows, rel)
+        np.testing.assert_array_equal(out[rel.old2new[0]], [10.])
+        np.testing.assert_array_equal(out[rel.old2new[3]], [40.])
