@@ -60,7 +60,8 @@ class Dataset:
             for etype, ei in edge_index.items():
                 eids = None if edge_ids is None else edge_ids.get(etype)
                 lo = layout[etype] if isinstance(layout, dict) else layout
-                nn = num_nodes.get(etype[2]) if isinstance(num_nodes, dict) else None
+                # CSR rows are the *source* type's nodes (out-edge CSR).
+                nn = num_nodes.get(etype[0]) if isinstance(num_nodes, dict) else None
                 topo = CSRTopo(ei, edge_ids=eids, layout=lo, num_nodes=nn)
                 graphs[etype] = Graph(topo, mode=graph_mode,
                                       with_sorted_columns=with_sorted_columns)

@@ -1,0 +1,107 @@
+"""HeteroNeighborLoader — heterogeneous neighbor-sampling loader.
+
+Rebuild of the reference's hetero loader path (loader/neighbor_loader.py
+hetero branch + loader/transform.py:54-104 ``to_hetero_data``): per-type
+feature/label joins over a :class:`HeteroSamplerOutput`.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterator, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import Dataset
+from ..sampler.base import NodeSamplerInput
+from ..sampler.hetero_neighbor_sampler import HeteroNeighborSampler
+from ..typing import NodeType, PADDING_ID
+from .transform import HeteroBatch, to_hetero_batch
+
+
+class HeteroNeighborLoader:
+    def __init__(
+        self,
+        data: Dataset,
+        num_neighbors,
+        input_nodes,
+        batch_size: int = 512,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        prefetch: int = 2,
+        seed: int = 0,
+        sampler: Optional[HeteroNeighborSampler] = None,
+    ):
+        if isinstance(input_nodes, tuple):
+            input_type, seeds = input_nodes
+        else:
+            raise ValueError(
+                "input_nodes must be (node_type, ids) for hetero loading")
+        self.data = data
+        self.input_type: NodeType = input_type
+        self.input_nodes = np.asarray(seeds).astype(np.int64)
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.prefetch = max(1, int(prefetch))
+        self._rng = np.random.default_rng(seed)
+        self._labels_dev = {}
+        if sampler is None:
+            sampler = HeteroNeighborSampler(
+                data.graph, num_neighbors, input_type,
+                batch_size=batch_size, seed=seed)
+        self.sampler = sampler
+
+    def __len__(self) -> int:
+        n = self.input_nodes.shape[0]
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def _epoch_seed_batches(self):
+        ids = self.input_nodes
+        if self.shuffle:
+            ids = ids[self._rng.permutation(ids.shape[0])]
+        n = ids.shape[0]
+        end = (n // self.batch_size) * self.batch_size if self.drop_last else n
+        for lo in range(0, end, self.batch_size):
+            yield ids[lo: lo + self.batch_size]
+
+    def __iter__(self) -> Iterator[HeteroBatch]:
+        pending = deque()
+        batches = self._epoch_seed_batches()
+        while True:
+            while len(pending) < self.prefetch:
+                seeds = next(batches, None)
+                if seeds is None:
+                    break
+                pending.append(
+                    (self.sampler.sample_from_nodes(
+                        NodeSamplerInput(seeds, self.input_type)),
+                     seeds.shape[0]))
+            if not pending:
+                return
+            out, nseeds = pending.popleft()
+            yield self._collate_fn(out, nseeds)
+
+    def _collate_fn(self, out, num_seeds: int) -> HeteroBatch:
+        x = {}
+        for t, node in out.node.items():
+            feat = self.data.get_node_feature(t)
+            if feat is not None:
+                x[t] = feat.gather(node)
+        y = None
+        labels = self.data.node_labels
+        if isinstance(labels, dict):
+            y = {}
+            for t, lab in labels.items():
+                if t not in out.node:
+                    continue
+                if t not in self._labels_dev:
+                    self._labels_dev[t] = jnp.asarray(np.asarray(lab))
+                node = out.node[t]
+                safe = jnp.clip(node, 0, self._labels_dev[t].shape[0] - 1)
+                y[t] = jnp.where(node >= 0,
+                                 jnp.take(self._labels_dev[t], safe, axis=0),
+                                 PADDING_ID)
+        return to_hetero_batch(out, x=x, y=y, batch_size=num_seeds)
