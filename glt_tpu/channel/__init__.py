@@ -1,0 +1,12 @@
+from .base import ChannelBase, SampleMessage
+from .serialization import deserialize, serialize, serialized_size
+from .shm_channel import ShmChannel
+
+__all__ = [
+    "ChannelBase",
+    "SampleMessage",
+    "ShmChannel",
+    "deserialize",
+    "serialize",
+    "serialized_size",
+]

@@ -1,0 +1,26 @@
+"""Channel interface (cf. graphlearn_torch/python/channel/base.py).
+
+A ``SampleMessage`` is a flat ``Dict[str, np.ndarray]``; channels move them
+between the sampling producer and the trainer.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Dict
+
+import numpy as np
+
+SampleMessage = Dict[str, np.ndarray]
+
+
+class ChannelBase(ABC):
+    @abstractmethod
+    def send(self, msg: SampleMessage) -> None:
+        raise NotImplementedError
+
+    @abstractmethod
+    def recv(self) -> SampleMessage:
+        raise NotImplementedError
+
+    def empty(self) -> bool:
+        return False

@@ -1,0 +1,52 @@
+"""ctypes bindings + build for the native shm queue (csrc/shm_queue.cc)."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SO = os.path.join(_CSRC, "build", "libglt_shm.so")
+_LOCK = threading.Lock()
+_LIB = None
+
+
+def ensure_built() -> str:
+    src = os.path.join(_CSRC, "shm_queue.cc")
+    if (not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(src)):
+        os.makedirs(os.path.dirname(_SO), exist_ok=True)
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-pthread", "-std=c++17",
+             src, "-o", _SO, "-lrt"],
+            check=True, capture_output=True)
+    return _SO
+
+
+def lib() -> ctypes.CDLL:
+    global _LIB
+    with _LOCK:
+        if _LIB is None:
+            L = ctypes.CDLL(ensure_built())
+            L.glt_shmq_create.restype = ctypes.c_void_p
+            L.glt_shmq_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+            L.glt_shmq_attach.restype = ctypes.c_void_p
+            L.glt_shmq_attach.argtypes = [ctypes.c_char_p]
+            L.glt_shmq_enqueue.restype = ctypes.c_int
+            L.glt_shmq_enqueue.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           ctypes.c_uint64]
+            L.glt_shmq_next_size.restype = ctypes.c_uint64
+            L.glt_shmq_next_size.argtypes = [ctypes.c_void_p]
+            L.glt_shmq_dequeue.restype = ctypes.c_int64
+            L.glt_shmq_dequeue.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                           ctypes.c_uint64]
+            L.glt_shmq_msg_count.restype = ctypes.c_uint64
+            L.glt_shmq_msg_count.argtypes = [ctypes.c_void_p]
+            L.glt_shmq_close.restype = None
+            L.glt_shmq_close.argtypes = [ctypes.c_void_p]
+            L.glt_shmq_unlink.restype = ctypes.c_int
+            L.glt_shmq_unlink.argtypes = [ctypes.c_char_p]
+            _LIB = L
+    return _LIB

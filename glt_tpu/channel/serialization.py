@@ -1,0 +1,70 @@
+"""Flat byte serialization of SampleMessage (= Dict[str, ndarray]).
+
+Same layout as the reference's TensorMap serializer
+(include/tensor_map.h:24-28, csrc/tensor_map.cc):
+
+    | u32 tensor_num |
+    per tensor: | u32 key_len | key | u32 dtype_code | u32 ndim |
+                | u64 shape[ndim] | u64 data_len | data |
+
+Numpy-native here (the payload is host-side either way; the trainer hands
+the deserialized arrays to ``jax.device_put``).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict
+
+import numpy as np
+
+_DTYPES = [np.dtype(x) for x in (
+    "float32", "float64", "int32", "int64", "int16", "int8", "uint8",
+    "bool", "float16")]
+_DTYPE_CODE = {dt: i for i, dt in enumerate(_DTYPES)}
+
+
+def serialized_size(msg: Dict[str, np.ndarray]) -> int:
+    total = 4
+    for k, v in msg.items():
+        v = np.asarray(v)
+        total += 4 + len(k.encode()) + 4 + 4 + 8 * v.ndim + 8 + v.nbytes
+    return total
+
+
+def serialize(msg: Dict[str, np.ndarray]) -> bytes:
+    parts = [struct.pack("<I", len(msg))]
+    for k, v in msg.items():
+        v = np.ascontiguousarray(np.asarray(v))
+        if v.dtype not in _DTYPE_CODE:
+            raise TypeError(f"unsupported dtype {v.dtype} for key {k!r}")
+        kb = k.encode()
+        parts.append(struct.pack("<I", len(kb)))
+        parts.append(kb)
+        parts.append(struct.pack("<II", _DTYPE_CODE[v.dtype], v.ndim))
+        parts.append(struct.pack(f"<{v.ndim}Q", *v.shape))
+        parts.append(struct.pack("<Q", v.nbytes))
+        parts.append(v.tobytes())
+    return b"".join(parts)
+
+
+def deserialize(buf: memoryview) -> Dict[str, np.ndarray]:
+    buf = memoryview(buf)
+    (n,) = struct.unpack_from("<I", buf, 0)
+    off = 4
+    out: Dict[str, np.ndarray] = {}
+    for _ in range(n):
+        (klen,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        key = bytes(buf[off: off + klen]).decode()
+        off += klen
+        code, ndim = struct.unpack_from("<II", buf, off)
+        off += 8
+        shape = struct.unpack_from(f"<{ndim}Q", buf, off)
+        off += 8 * ndim
+        (nbytes,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        arr = np.frombuffer(buf[off: off + nbytes],
+                            dtype=_DTYPES[code]).reshape(shape).copy()
+        off += nbytes
+        out[key] = arr
+    return out

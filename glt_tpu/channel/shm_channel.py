@@ -1,0 +1,80 @@
+"""Shared-memory channel over the native C++ ring queue.
+
+Rebuild of ``channel/shm_channel.py`` + the native ``SampleQueue``
+(include/sample_queue.h, py_export.cc:125-140): capacity-bounded
+cross-process transport of serialized ``SampleMessage`` dicts, picklable by
+queue name so ``multiprocessing`` workers re-attach on the other side —
+the role the reference's shmid pickling plays.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import uuid
+from typing import Optional
+
+from .base import ChannelBase, SampleMessage
+from .native import lib
+from .serialization import deserialize, serialize
+
+
+class ShmChannel(ChannelBase):
+    """Args:
+      capacity_bytes: ring size (cf. MpDistSamplingWorkerOptions'
+        64MB/worker default, dist_options.py:202-254).
+      name: optional explicit shm name (attach when it already exists).
+    """
+
+    def __init__(self, capacity_bytes: int = 64 * 1024 * 1024,
+                 name: Optional[str] = None, _attach: bool = False):
+        self._lib = lib()
+        self.capacity = int(capacity_bytes)
+        self.name = name or f"/glt_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+        self._owner = not _attach
+        if _attach:
+            self._q = self._lib.glt_shmq_attach(self.name.encode())
+        else:
+            self._q = self._lib.glt_shmq_create(self.name.encode(),
+                                                self.capacity)
+        if not self._q:
+            raise OSError(f"failed to open shm queue {self.name}")
+
+    def send(self, msg: SampleMessage) -> None:
+        data = serialize(msg)
+        rc = self._lib.glt_shmq_enqueue(self._q, data, len(data))
+        if rc != 0:
+            raise ValueError(
+                f"message of {len(data)} bytes exceeds channel capacity "
+                f"{self.capacity}")
+
+    def recv(self) -> SampleMessage:
+        size = self._lib.glt_shmq_next_size(self._q)
+        buf = ctypes.create_string_buffer(size)
+        got = self._lib.glt_shmq_dequeue(self._q, buf, size)
+        if got < 0:
+            raise RuntimeError("shm dequeue failed")
+        return deserialize(memoryview(buf)[:got])
+
+    def empty(self) -> bool:
+        return self._lib.glt_shmq_msg_count(self._q) == 0
+
+    # -- pickling: re-attach by name on the other side ---------------------
+    def __reduce__(self):
+        return (_attach_channel, (self.name, self.capacity))
+
+    def close(self, unlink: Optional[bool] = None) -> None:
+        if self._q:
+            self._lib.glt_shmq_close(self._q)
+            self._q = None
+            if unlink if unlink is not None else self._owner:
+                self._lib.glt_shmq_unlink(self.name.encode())
+
+    def __del__(self):
+        try:
+            self.close(unlink=False)
+        except Exception:
+            pass
+
+
+def _attach_channel(name: str, capacity: int) -> ShmChannel:
+    return ShmChannel(capacity_bytes=capacity, name=name, _attach=True)

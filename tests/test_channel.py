@@ -1,0 +1,94 @@
+"""Native shm channel tests (cf. test/python/test_shm_channel.py +
+test/cpp/test_shm_queue.cu, test_tensor_map_serializer.cu)."""
+import multiprocessing as mp
+import numpy as np
+import pytest
+
+from glt_tpu.channel import ShmChannel, deserialize, serialize
+
+
+class TestSerialization:
+    def test_roundtrip(self):
+        msg = {
+            "node": np.arange(10, dtype=np.int64),
+            "x": np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32),
+            "mask": np.array([True, False, True]),
+            "#META.bs": np.array(7, dtype=np.int32),
+        }
+        out = deserialize(memoryview(serialize(msg)))
+        assert set(out) == set(msg)
+        for k in msg:
+            np.testing.assert_array_equal(out[k], msg[k])
+            assert out[k].dtype == np.asarray(msg[k]).dtype
+
+    def test_empty(self):
+        assert deserialize(memoryview(serialize({}))) == {}
+
+
+class TestShmChannel:
+    def test_send_recv_same_process(self):
+        ch = ShmChannel(capacity_bytes=1 << 20)
+        try:
+            msg = {"a": np.arange(5, dtype=np.int32),
+                   "b": np.ones((2, 2), np.float32)}
+            assert ch.empty()
+            ch.send(msg)
+            assert not ch.empty()
+            out = ch.recv()
+            np.testing.assert_array_equal(out["a"], msg["a"])
+            np.testing.assert_array_equal(out["b"], msg["b"])
+            assert ch.empty()
+        finally:
+            ch.close()
+
+    def test_fifo_many(self):
+        ch = ShmChannel(capacity_bytes=1 << 20)
+        try:
+            for i in range(50):
+                ch.send({"i": np.array([i])})
+            for i in range(50):
+                assert ch.recv()["i"][0] == i
+        finally:
+            ch.close()
+
+    def test_oversized_message_rejected(self):
+        ch = ShmChannel(capacity_bytes=4096)
+        try:
+            with pytest.raises(ValueError, match="capacity"):
+                ch.send({"big": np.zeros(10000, np.float64)})
+        finally:
+            ch.close()
+
+    def test_wraparound(self):
+        # ring smaller than total traffic: forces wrap handling
+        ch = ShmChannel(capacity_bytes=8192)
+        try:
+            for round_ in range(20):
+                msg = {"x": np.full(300, round_, np.int32)}
+                ch.send(msg)
+                out = ch.recv()
+                np.testing.assert_array_equal(out["x"], msg["x"])
+        finally:
+            ch.close()
+
+
+def _producer(ch, n):
+    for i in range(n):
+        ch.send({"i": np.array([i]), "payload": np.full(1000, i, np.float32)})
+
+
+class TestCrossProcess:
+    def test_producer_subprocess(self):
+        ctx = mp.get_context("spawn")
+        ch = ShmChannel(capacity_bytes=1 << 20)
+        try:
+            p = ctx.Process(target=_producer, args=(ch, 20))
+            p.start()
+            for i in range(20):
+                out = ch.recv()
+                assert out["i"][0] == i
+                assert (out["payload"] == i).all()
+            p.join(timeout=10)
+            assert p.exitcode == 0
+        finally:
+            ch.close()
