@@ -1,0 +1,14 @@
+from .dist_options import (
+    CollocatedSamplingWorkerOptions,
+    MpSamplingWorkerOptions,
+)
+from .dist_loader import DistNeighborLoader
+from .sample_message import batch_to_message, message_to_batch
+
+__all__ = [
+    "CollocatedSamplingWorkerOptions",
+    "DistNeighborLoader",
+    "MpSamplingWorkerOptions",
+    "batch_to_message",
+    "message_to_batch",
+]

@@ -1,0 +1,94 @@
+"""Channel-fed loader front-end (worker mode).
+
+Rebuild of ``distributed/dist_loader.py``: mode chosen by options type
+(:142-221) — collocated falls through to the in-process
+:class:`~glt_tpu.loader.node_loader.NeighborLoader`; mp mode spawns CPU
+sampling subprocesses and the trainer iterates channel messages
+(``__next__`` = channel recv + reconstruct, :246-383), overlapping host
+sampling with device training exactly like the reference overlaps its
+producer fleet with DDP compute.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional, Sequence, Union
+
+import numpy as np
+
+from ..channel import ShmChannel
+from ..loader.node_loader import NeighborLoader
+from ..loader.transform import Batch
+from .dist_options import (
+    CollocatedSamplingWorkerOptions,
+    MpSamplingWorkerOptions,
+)
+from .dist_sampling_producer import MpSamplingProducer
+from .sample_message import message_to_batch
+
+
+class DistNeighborLoader:
+    """Neighbor loader with selectable sampling-worker deployment.
+
+    Collocated mode needs a live ``dataset``; mp mode needs a picklable
+    ``dataset_builder`` (workers rebuild the dataset host-side).
+    """
+
+    def __init__(
+        self,
+        num_neighbors: Sequence[int],
+        input_nodes: np.ndarray,
+        batch_size: int = 512,
+        shuffle: bool = False,
+        dataset=None,
+        dataset_builder: Optional[Callable] = None,
+        builder_args: tuple = (),
+        worker_options=None,
+        seed: int = 0,
+    ):
+        worker_options = worker_options or CollocatedSamplingWorkerOptions()
+        self.options = worker_options
+        self._inner: Optional[NeighborLoader] = None
+        self._producer: Optional[MpSamplingProducer] = None
+
+        if isinstance(worker_options, CollocatedSamplingWorkerOptions):
+            if dataset is None:
+                raise ValueError("collocated mode requires dataset=")
+            self._inner = NeighborLoader(
+                dataset, num_neighbors, input_nodes, batch_size=batch_size,
+                shuffle=shuffle, seed=seed)
+        elif isinstance(worker_options, MpSamplingWorkerOptions):
+            if dataset_builder is None:
+                raise ValueError("mp mode requires dataset_builder=")
+            self.channel = ShmChannel(
+                capacity_bytes=worker_options.channel_capacity_bytes)
+            self._producer = MpSamplingProducer(
+                dataset_builder, builder_args, num_neighbors, input_nodes,
+                batch_size, worker_options, self.channel, shuffle=shuffle)
+            self._producer.init()
+        else:
+            raise TypeError(f"unknown worker options {worker_options!r}")
+
+    def __iter__(self) -> Iterator[Batch]:
+        if self._inner is not None:
+            yield from self._inner
+            return
+        # epoch protocol (cf. dist_loader.py:259-272)
+        self._producer.produce_all()
+        for _ in range(self._producer.num_expected()):
+            yield message_to_batch(self.channel.recv())
+
+    def __len__(self) -> int:
+        if self._inner is not None:
+            return len(self._inner)
+        return self._producer.num_expected()
+
+    def shutdown(self) -> None:
+        if self._producer is not None:
+            self._producer.shutdown()
+            self.channel.close()
+            self._producer = None
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass

@@ -1,0 +1,29 @@
+"""Sampling worker options (cf. distributed/dist_options.py).
+
+The reference selects its loader mode by option type (dist_loader.py:
+142-221): collocated (sync in-process), mp (sampling subprocesses + shm
+channel), or remote (server-side producers).  The TPU build keeps the same
+pattern; 'remote' is intentionally absent this round — on TPU, remote
+sampling maps to separate host processes feeding the same shm channel.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class CollocatedSamplingWorkerOptions:
+    """Sample in-process, synchronously (the default fused-on-device path)."""
+
+
+@dataclasses.dataclass
+class MpSamplingWorkerOptions:
+    """Sample in ``num_workers`` CPU subprocesses feeding a shm channel.
+
+    Mirrors ``MpDistSamplingWorkerOptions`` (dist_options.py:202-254):
+    per-worker channel capacity, pinned host staging, worker seeds split
+    batch-aligned (dist_sampling_producer.py:229-247).
+    """
+    num_workers: int = 2
+    channel_capacity_bytes: int = 64 * 1024 * 1024
+    worker_seed: int = 0

@@ -1,0 +1,136 @@
+"""Multiprocess sampling producers feeding a shm channel.
+
+Rebuild of ``distributed/dist_sampling_producer.py``: the reference spawns
+N sampling subprocesses, each running ``_sampling_worker_loop`` — init RPC,
+build a sampler, pull seed slices from an mp task queue, push sampled
+messages into the shm channel (:52-260).  TPU differences: workers run the
+**CPU JAX backend** (the TPU chip belongs to the trainer process), build
+their dataset from a picklable builder (typically mmap-backed .npy loads,
+replacing the reference's shared-memory tensor IPC), and ship fully
+collated host batches (features gathered worker-side via ``cpu_get``, as
+the reference's workers do).  Commands mirror the reference's
+``SAMPLE_ALL`` / ``STOP`` protocol.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..channel import ShmChannel
+from .dist_options import MpSamplingWorkerOptions
+from .sample_message import batch_to_message
+
+_CMD_SAMPLE_EPOCH = 0
+_CMD_STOP = 1
+
+
+def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
+                          num_neighbors, batch_size, channel, task_queue,
+                          seed):
+    """Subprocess body (cf. dist_sampling_producer.py:52)."""
+    # The TPU chip belongs to the trainer; workers sample on host CPU.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ..loader.node_loader import NodeLoader
+    from ..sampler.base import NodeSamplerInput
+    from ..sampler.neighbor_sampler import NeighborSampler
+
+    data = dataset_builder(*builder_args)
+    sampler = NeighborSampler(data.get_graph(), num_neighbors,
+                              batch_size=batch_size,
+                              seed=seed + worker_id)
+    collate_loader = NodeLoader(data, sampler, np.empty(0, np.int64),
+                                batch_size=batch_size)
+
+    while True:
+        cmd, payload = task_queue.get()
+        if cmd == _CMD_STOP:
+            break
+        seeds_chunk = payload
+        for lo in range(0, seeds_chunk.shape[0], batch_size):
+            seeds = seeds_chunk[lo: lo + batch_size]
+            out = sampler.sample_from_nodes(NodeSamplerInput(seeds))
+            batch = collate_loader._collate_fn(out, seeds.shape[0])
+            channel.send(batch_to_message(batch))
+
+
+class MpSamplingProducer:
+    """Spawn + drive sampling workers (cf. DistMpSamplingProducer).
+
+    Args:
+      dataset_builder: picklable top-level callable rebuilding the Dataset
+        inside each worker (e.g. mmap .npy loads).
+      input_nodes: global seed ids for this loader.
+    """
+
+    def __init__(
+        self,
+        dataset_builder: Callable,
+        builder_args: tuple,
+        num_neighbors: Sequence[int],
+        input_nodes: np.ndarray,
+        batch_size: int,
+        options: MpSamplingWorkerOptions,
+        channel: ShmChannel,
+        shuffle: bool = False,
+    ):
+        self.input_nodes = np.asarray(input_nodes).astype(np.int64)
+        self.batch_size = int(batch_size)
+        self.options = options
+        self.channel = channel
+        self.shuffle = shuffle
+        self._rng = np.random.default_rng(options.worker_seed)
+        self._ctx = mp.get_context("spawn")
+        self._task_queues = []
+        self._workers = []
+        self._builder = (dataset_builder, builder_args, list(num_neighbors))
+
+    def init(self) -> None:
+        builder, args, nn = self._builder
+        for w in range(self.options.num_workers):
+            tq = self._ctx.Queue()
+            p = self._ctx.Process(
+                target=_sampling_worker_loop,
+                args=(w, builder, args, nn, self.batch_size, self.channel,
+                      tq, self.options.worker_seed),
+                daemon=True)
+            p.start()
+            self._task_queues.append(tq)
+            self._workers.append(p)
+
+    def num_expected(self) -> int:
+        n = self.input_nodes.shape[0]
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def produce_all(self) -> None:
+        """Kick one epoch: split seeds batch-aligned across workers
+        (cf. dist_sampling_producer.py:229-247)."""
+        ids = self.input_nodes
+        if self.shuffle:
+            ids = ids[self._rng.permutation(ids.shape[0])]
+        k = max(1, len(self._workers))
+        batches_per_worker = (self.num_expected() + k - 1) // k
+        span = batches_per_worker * self.batch_size
+        for w, tq in enumerate(self._task_queues):
+            chunk = ids[w * span: (w + 1) * span]
+            if chunk.shape[0] > 0:
+                tq.put((_CMD_SAMPLE_EPOCH, chunk))
+
+    def shutdown(self) -> None:
+        for tq in self._task_queues:
+            try:
+                tq.put((_CMD_STOP, None))
+            except Exception:
+                pass
+        for p in self._workers:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        self._workers.clear()
+        self._task_queues.clear()

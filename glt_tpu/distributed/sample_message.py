@@ -1,0 +1,61 @@
+"""SamplerOutput/Batch <-> flat SampleMessage conversion.
+
+Rebuild of the reference's message flattening
+(dist_neighbor_sampler.py:600-673 ``_colloate_fn``): everything a batch
+carries is flattened into a string-keyed dict of host arrays with ``#META.*``
+scalar keys, shipped over a channel, and reconstructed loader-side
+(dist_loader.py:246-383).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..channel.base import SampleMessage
+from ..loader.transform import Batch
+
+_META_BS = "#META.batch_size"
+
+
+def batch_to_message(batch: Batch) -> SampleMessage:
+    msg: SampleMessage = {
+        "node": np.asarray(batch.node),
+        "row": np.asarray(batch.edge_index[0]),
+        "col": np.asarray(batch.edge_index[1]),
+        "node_mask": np.asarray(batch.node_mask),
+        "edge_mask": np.asarray(batch.edge_mask),
+        _META_BS: np.array(batch.batch_size, np.int64),
+    }
+    if batch.edge_id is not None:
+        msg["edge"] = np.asarray(batch.edge_id)
+    if batch.batch is not None:
+        msg["batch"] = np.asarray(batch.batch)
+    if batch.x is not None:
+        msg["x"] = np.asarray(batch.x)
+    if batch.y is not None:
+        msg["y"] = np.asarray(batch.y)
+    if batch.metadata:
+        for k, v in batch.metadata.items():
+            msg[f"#META.{k}"] = np.asarray(v)
+    return msg
+
+
+def message_to_batch(msg: SampleMessage, to_device: bool = True) -> Batch:
+    conv = jnp.asarray if to_device else np.asarray
+    meta = {k[len("#META."):]: conv(v) for k, v in msg.items()
+            if k.startswith("#META.") and k != _META_BS}
+    return Batch(
+        x=conv(msg["x"]) if "x" in msg else None,
+        y=conv(msg["y"]) if "y" in msg else None,
+        edge_index=jnp.stack([conv(msg["row"]), conv(msg["col"])])
+        if to_device else np.stack([msg["row"], msg["col"]]),
+        edge_id=conv(msg["edge"]) if "edge" in msg else None,
+        node=conv(msg["node"]),
+        node_mask=conv(msg["node_mask"]),
+        edge_mask=conv(msg["edge_mask"]),
+        batch=conv(msg["batch"]) if "batch" in msg else None,
+        batch_size=int(np.asarray(msg[_META_BS]).ravel()[0]),
+        metadata=meta or None,
+    )
