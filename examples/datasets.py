@@ -1,0 +1,133 @@
+"""Example datasets: real OGB data when present on disk, synthetic otherwise.
+
+The container has no network egress, so examples default to synthetic
+graphs shaped like their real counterparts (node/edge counts scaled by
+--scale).  Drop pre-downloaded OGB .npy files under DATA_ROOT to run the
+real thing:
+
+    DATA_ROOT/<name>/{indptr,indices,feat,labels,train_idx}.npy
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from glt_tpu.data import CSRTopo, Dataset
+
+DATA_ROOT = os.environ.get("GLT_DATA_ROOT", "/root/data")
+
+
+def _from_disk(name: str, graph_mode: str):
+    root = os.path.join(DATA_ROOT, name)
+    if not os.path.isdir(root):
+        return None
+    load = lambda f: np.load(os.path.join(root, f + ".npy"), mmap_mode="r")
+    topo = CSRTopo((np.asarray(load("indptr")), np.asarray(load("indices"))),
+                   layout="CSR")
+    ds = Dataset()
+    ds.graph = __import__("glt_tpu.data.graph", fromlist=["Graph"]).Graph(
+        topo, mode=graph_mode)
+    ds.init_node_features(np.asarray(load("feat")))
+    ds.init_node_labels(np.asarray(load("labels")))
+    return ds, np.asarray(load("train_idx"))
+
+
+def synthetic_products(scale: float = 0.01, dim: int = 100,
+                       num_classes: int = 47, graph_mode: str = "DEVICE",
+                       seed: int = 0):
+    """ogbn-products-shaped synthetic graph (2.45M nodes / 62M edges at
+    scale=1.0) with learnable community structure."""
+    real = _from_disk("ogbn-products", graph_mode)
+    if real is not None:
+        return real
+
+    rng = np.random.default_rng(seed)
+    n = max(1000, int(2_449_029 * scale))
+    deg = 12
+    labels = rng.integers(0, num_classes, n).astype(np.int32)
+    # Community-biased neighbors: ~70% same-class, rest uniform.
+    indptr = (np.arange(n + 1) * deg).astype(np.int64)
+    targets = rng.integers(0, n, (n, deg), dtype=np.int64)
+    same_mask = rng.random((n, deg)) < 0.7
+    # redirect same-class picks to a random member of the same class
+    class_members = [np.flatnonzero(labels == c) for c in range(num_classes)]
+    for c in range(num_classes):
+        rows = np.flatnonzero(labels == c)
+        picks = rng.choice(class_members[c], size=(rows.shape[0], deg))
+        targets[rows] = np.where(same_mask[rows], picks, targets[rows])
+    indices = targets.reshape(-1)
+
+    feat = (np.eye(num_classes, dtype=np.float32)[labels]
+            @ rng.normal(0, 1, (num_classes, dim)).astype(np.float32))
+    feat += rng.normal(0, 0.5, (n, dim)).astype(np.float32)
+
+    topo = CSRTopo((indptr.astype(np.int32), indices.astype(np.int32)),
+                   layout="CSR")
+    from glt_tpu.data.graph import Graph
+
+    ds = Dataset(graph=Graph(topo, mode=graph_mode))
+    ds.init_node_features(feat)
+    ds.init_node_labels(labels)
+    train_idx = rng.permutation(n)[: int(n * 0.1)]
+    return ds, train_idx
+
+
+def synthetic_ppi(scale: float = 1.0, dim: int = 50, seed: int = 0,
+                  graph_mode: str = "DEVICE"):
+    """PPI-shaped graph for unsupervised link prediction."""
+    rng = np.random.default_rng(seed)
+    n = max(500, int(14_755 * scale))
+    deg = 14
+    indptr = (np.arange(n + 1) * deg).astype(np.int64)
+    indices = rng.integers(0, n, n * deg, dtype=np.int64)
+    feat = rng.normal(size=(n, dim)).astype(np.float32)
+    topo = CSRTopo((indptr.astype(np.int32), indices.astype(np.int32)),
+                   layout="CSR")
+    from glt_tpu.data.graph import Graph
+
+    ds = Dataset(graph=Graph(topo, mode=graph_mode,
+                             with_sorted_columns=True))
+    ds.init_node_features(feat)
+    src, dst = topo.to_coo()
+    return ds, np.stack([src, dst])
+
+
+def synthetic_igbh(scale: float = 1.0, seed: int = 0,
+                   graph_mode: str = "DEVICE"):
+    """IGBH-tiny-shaped hetero graph: paper/author/institute."""
+    rng = np.random.default_rng(seed)
+    n_paper = max(200, int(1000 * scale))
+    n_author = max(150, int(800 * scale))
+    n_inst = max(20, int(80 * scale))
+    classes = 8
+
+    def rand_edges(ns, nd, deg):
+        src = np.repeat(np.arange(ns), deg)
+        dst = rng.integers(0, nd, ns * deg)
+        return np.stack([src, dst])
+
+    cites = rand_edges(n_paper, n_paper, 4)
+    writes = rand_edges(n_author, n_paper, 3)
+    affil = rand_edges(n_author, n_inst, 1)
+    ei = {
+        ("paper", "cites", "paper"): cites,
+        ("author", "writes", "paper"): writes,
+        ("paper", "rev_writes", "author"): writes[::-1],
+        ("author", "affiliated", "institute"): affil,
+        ("institute", "rev_affiliated", "author"): affil[::-1],
+    }
+    labels = rng.integers(0, classes, n_paper).astype(np.int32)
+    feats = {
+        "paper": (np.eye(classes, dtype=np.float32)[labels]
+                  + rng.normal(0, .3, (n_paper, classes)).astype(np.float32)),
+        "author": rng.normal(size=(n_author, classes)).astype(np.float32),
+        "institute": rng.normal(size=(n_inst, classes)).astype(np.float32),
+    }
+    ds = (Dataset()
+          .init_graph(ei, graph_mode=graph_mode,
+                      num_nodes={"paper": n_paper, "author": n_author,
+                                 "institute": n_inst})
+          .init_node_features(feats)
+          .init_node_labels({"paper": labels}))
+    return ds, np.arange(n_paper), classes
