@@ -1,0 +1,47 @@
+"""Checkpoint / resume for training state.
+
+The reference leaves checkpointing to user PyTorch code (SURVEY §5:
+absent from the library); a complete TPU framework ships it: orbax-backed
+save/restore of the :class:`~glt_tpu.models.train.TrainState` pytree plus
+loader epoch/step bookkeeping, so long runs resume exactly.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(path: str, state: Any, step: Optional[int] = None) -> str:
+    """Save a pytree (e.g. TrainState) to ``path`` (or ``path/step_N``)."""
+    if step is not None:
+        path = os.path.join(path, f"step_{step}")
+    path = os.path.abspath(path)
+    _checkpointer().save(path, jax.device_get(state), force=True)
+    return path
+
+
+def restore_checkpoint(path: str, template: Any) -> Any:
+    """Restore a pytree saved by :func:`save_checkpoint`.
+
+    ``template`` supplies structure/dtypes (pass an initialized state).
+    """
+    restored = _checkpointer().restore(os.path.abspath(path),
+                                       item=jax.device_get(template))
+    return restored
+
+
+def latest_step(path: str) -> Optional[int]:
+    """Newest ``step_N`` subdirectory under ``path``, or None."""
+    if not os.path.isdir(path):
+        return None
+    steps = [int(d[len("step_"):]) for d in os.listdir(path)
+             if d.startswith("step_") and d[len("step_"):].isdigit()]
+    return max(steps) if steps else None

@@ -1,0 +1,71 @@
+"""Profiling + throughput metering.
+
+The reference has no profiler hooks (SURVEY §5 — benchmarks hand-roll
+wall-clock + cuda sync).  On TPU the jax profiler is nearly free, so the
+framework wires it in: ``trace()`` wraps a region for Perfetto/XPlane
+capture, and :class:`ThroughputMeter` standardizes the metric definitions
+the benchmarks print (sampled edges/s, feature GB/s, subgraphs/s).
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a jax profiler trace for the enclosed region."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Name a region inside a profiler trace."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class ThroughputMeter:
+    """Accumulate counts over wall-clock; report rates.
+
+    >>> m = ThroughputMeter()
+    >>> with m.measure():
+    ...     run_epoch()           # call m.add(edges=..., batches=...) inside
+    >>> m.rate("edges")           # edges/sec
+    """
+
+    def __init__(self):
+        self._counts: Dict[str, float] = {}
+        self._elapsed = 0.0
+        self._t0: Optional[float] = None
+
+    def add(self, **counts: float) -> None:
+        for k, v in counts.items():
+            self._counts[k] = self._counts.get(k, 0.0) + float(v)
+
+    @contextlib.contextmanager
+    def measure(self):
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            self._elapsed += time.perf_counter() - t0
+
+    @property
+    def elapsed(self) -> float:
+        return self._elapsed
+
+    def rate(self, key: str) -> float:
+        if self._elapsed == 0:
+            return 0.0
+        return self._counts.get(key, 0.0) / self._elapsed
+
+    def summary(self) -> Dict[str, float]:
+        return {f"{k}_per_sec": self.rate(k) for k in self._counts}

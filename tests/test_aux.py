@@ -1,0 +1,51 @@
+"""Aux subsystem tests: throughput meter, checkpoint round-trip."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from glt_tpu.models import GraphSAGE, TrainState, create_train_state
+from glt_tpu.utils.checkpoint import (
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from glt_tpu.utils.profile import ThroughputMeter
+
+
+def test_throughput_meter():
+    m = ThroughputMeter()
+    with m.measure():
+        m.add(edges=1000, batches=2)
+    assert m.rate("edges") > 0
+    assert m.summary()["batches_per_sec"] > 0
+
+
+def _tiny_state():
+    model = GraphSAGE(hidden_features=4, out_features=2, num_layers=1)
+    x = jnp.ones((6, 3))
+    ei = jnp.array([[1, 2], [0, 0]])
+    mask = jnp.ones(2, bool)
+
+    class B:
+        pass
+
+    b = B()
+    b.x, b.edge_index, b.edge_mask = x, ei, mask
+    tx = optax.adam(1e-3)
+    return create_train_state(model, jax.random.PRNGKey(0), b, tx), tx
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    state, tx = _tiny_state()
+    p = save_checkpoint(str(tmp_path / "ckpt"), state, step=7)
+    assert "step_7" in p
+    assert latest_step(str(tmp_path / "ckpt")) == 7
+
+    state2, _ = _tiny_state()
+    restored = restore_checkpoint(p, state2)
+    a = jax.tree_util.tree_leaves(state.params)
+    b = jax.tree_util.tree_leaves(restored.params)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
