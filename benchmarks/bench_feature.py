@@ -1,0 +1,55 @@
+"""Feature-lookup throughput benchmark (GB/s).
+
+Metric definition follows the reference's benchmarks/api/bench_feature.py
+(:60,96,120): gather random row batches from the tiered feature store,
+report GB/s, with --split-ratio controlling the HBM-resident fraction.
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-nodes", type=int, default=2_000_000)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=100_000)
+    ap.add_argument("--split-ratio", type=float, default=1.0)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from glt_tpu.data.feature import Feature
+
+    rng = np.random.default_rng(0)
+    feat = rng.normal(size=(args.num_nodes, args.dim)).astype(np.float32)
+    store = Feature(feat, split_ratio=args.split_ratio)
+
+    batches = [jnp.asarray(rng.integers(0, args.num_nodes, args.batch))
+               for _ in range(args.iters + 3)]
+    gather = (jax.jit(store.gather) if args.split_ratio >= 1.0
+              else store.gather)
+
+    for i in range(3):
+        jax.block_until_ready(gather(batches[i]))
+    t0 = time.perf_counter()
+    outs = [gather(b) for b in batches[3:]]
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+
+    gb = args.iters * args.batch * args.dim * 4 / 1e9
+    print(f"split_ratio={args.split_ratio} "
+          f"throughput {gb / dt:.2f} GB/s "
+          f"({args.batch} rows x {args.dim} dims x {args.iters} iters "
+          f"in {dt:.3f}s)")
+
+
+if __name__ == "__main__":
+    main()

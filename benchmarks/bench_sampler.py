@@ -1,0 +1,65 @@
+"""Sampler throughput benchmark with configurable shape.
+
+Reference metric (benchmarks/api/bench_sampler.py:27-54): "Sampled Edges
+per sec (M)"; this is the configurable version of the repo-root bench.py
+headline (different fanouts, batch sizes, hop counts, graph scales).
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-nodes", type=int, default=2_449_029)
+    ap.add_argument("--degree", type=int, default=25)
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--fanout", type=int, nargs="+", default=[15, 10, 5])
+    ap.add_argument("--frontier-cap", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from glt_tpu.data.graph import Graph
+    from glt_tpu.data.topology import CSRTopo
+    from glt_tpu.sampler.base import NodeSamplerInput
+    from glt_tpu.sampler.neighbor_sampler import NeighborSampler
+
+    rng = np.random.default_rng(0)
+    n, deg = args.num_nodes, args.degree
+    topo = CSRTopo.__new__(CSRTopo)
+    topo._indptr = (np.arange(n + 1, dtype=np.int64) * deg).astype(np.int32)
+    topo._indices = rng.integers(0, n, n * deg, dtype=np.int32)
+    topo._edge_ids = np.arange(n * deg, dtype=np.int32)
+    topo._edge_weights = None
+
+    sampler = NeighborSampler(Graph(topo, mode="DEVICE"), args.fanout,
+                              batch_size=args.batch,
+                              frontier_cap=args.frontier_cap)
+    seeds = [rng.integers(0, n, args.batch, dtype=np.int64)
+             for _ in range(args.iters + 3)]
+
+    for i in range(3):
+        jax.block_until_ready(
+            sampler.sample_from_nodes(NodeSamplerInput(seeds[i])).node)
+    t0 = time.perf_counter()
+    outs = [sampler.sample_from_nodes(NodeSamplerInput(s)).num_sampled_edges
+            for s in seeds[3:]]
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+
+    edges = float(sum(int(np.asarray(o).sum()) for o in outs))
+    print(f"fanout={args.fanout} batch={args.batch}: "
+          f"{edges / dt / 1e6:.1f} M sampled edges/s "
+          f"({args.iters} iters in {dt:.3f}s)")
+
+
+if __name__ == "__main__":
+    main()

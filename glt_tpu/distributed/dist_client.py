@@ -1,0 +1,110 @@
+"""Client side of the server–client deployment.
+
+Rebuild of ``distributed/dist_client.py`` + the pull-based
+``RemoteReceivingChannel`` (channel/remote_channel.py:24-100): the client
+asks the server to create a producer, kicks epochs, and prefetches sampled
+messages over the socket with a configurable depth (default 4, matching
+RemoteDistSamplingWorkerOptions, dist_options.py:202-254).
+"""
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import threading
+from typing import Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..channel.serialization import deserialize
+from ..loader.transform import Batch
+from .dist_server import _KIND_JSON, _KIND_MSG, recv_frame, send_frame
+from .sample_message import message_to_batch
+
+
+class RemoteServerConnection:
+    def __init__(self, addr: Tuple[str, int]):
+        self.sock = socket.create_connection(addr)
+        self._lock = threading.Lock()
+
+    def request(self, **req) -> dict:
+        with self._lock:
+            send_frame(self.sock, _KIND_JSON, json.dumps(req).encode())
+            kind, data = recv_frame(self.sock)
+        if kind != _KIND_JSON:
+            raise RuntimeError("expected JSON response")
+        resp = json.loads(data)
+        if "error" in resp:
+            raise RuntimeError(f"server error: {resp['error']}")
+        return resp
+
+    def fetch_message(self, producer_id: int):
+        with self._lock:
+            send_frame(self.sock, _KIND_JSON, json.dumps(
+                {"op": "fetch_one_sampled_message",
+                 "producer_id": producer_id}).encode())
+            kind, data = recv_frame(self.sock)
+        if kind != _KIND_MSG:
+            raise RuntimeError(
+                json.loads(data).get("error", "bad frame"))
+        return deserialize(memoryview(data))
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+class RemoteNeighborLoader:
+    """Loader iterating batches produced on a remote sampling server
+    (the reference's DistLoader 'remote' mode, dist_loader.py:188-217)."""
+
+    def __init__(
+        self,
+        server_addr: Tuple[str, int],
+        num_neighbors: Sequence[int],
+        input_nodes: np.ndarray,
+        batch_size: int = 512,
+        prefetch: int = 4,
+        seed: int = 0,
+    ):
+        self.conn = RemoteServerConnection(server_addr)
+        resp = self.conn.request(
+            op="create_sampling_producer",
+            num_neighbors=list(num_neighbors),
+            input_nodes=np.asarray(input_nodes).tolist(),
+            batch_size=int(batch_size),
+            seed=seed)
+        self.producer_id = resp["producer_id"]
+        self.num_expected = resp["num_expected"]
+        self.prefetch = max(1, int(prefetch))
+
+    def __len__(self) -> int:
+        return self.num_expected
+
+    def __iter__(self) -> Iterator[Batch]:
+        self.conn.request(op="start_new_epoch_sampling",
+                          producer_id=self.producer_id)
+        buf: "queue.Queue" = queue.Queue()
+        stop = threading.Event()
+
+        def prefetcher():
+            for _ in range(self.num_expected):
+                if stop.is_set():
+                    return
+                buf.put(self.conn.fetch_message(self.producer_id))
+
+        t = threading.Thread(target=prefetcher, daemon=True)
+        t.start()
+        try:
+            for _ in range(self.num_expected):
+                yield message_to_batch(buf.get())
+        finally:
+            stop.set()
+
+    def shutdown(self, exit_server: bool = False) -> None:
+        try:
+            self.conn.request(op="destroy_sampling_producer",
+                              producer_id=self.producer_id)
+            if exit_server:
+                self.conn.request(op="exit")
+        finally:
+            self.conn.close()

@@ -1,0 +1,184 @@
+"""Sampling server: owns the dataset, produces batches for remote clients.
+
+Rebuild of ``distributed/dist_server.py``: the reference's server owns a
+DistDataset plus a pool of mp producers + shm buffers, and clients RPC
+``create_sampling_producer / start_new_epoch_sampling /
+fetch_one_sampled_message / destroy`` over torch RPC (:38-144).  The TPU
+build speaks a small length-prefixed TCP protocol instead (JSON control
+frames + TensorMap-serialized sample frames) — the transport the zero-
+dependency host runtime actually needs; RDMA-class speed on-host comes from
+the shm channel path, and cross-host bulk data rides the same socket.
+
+Protocol (all frames ``u32 kind | u64 len | payload``):
+  kind 0: JSON control request/response
+  kind 1: serialized SampleMessage
+"""
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import struct
+import threading
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from ..channel.serialization import deserialize, serialize
+
+_KIND_JSON = 0
+_KIND_MSG = 1
+
+
+def send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
+    sock.sendall(struct.pack("<IQ", kind, len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket):
+    hdr = _recv_exact(sock, 12)
+    if hdr is None:
+        return None, None
+    kind, length = struct.unpack("<IQ", hdr)
+    data = _recv_exact(sock, length)
+    return kind, data
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class _Producer:
+    """Server-side sampling producer: a thread filling a bounded queue
+    (the reference's producer + shm buffer pair, dist_server.py:83-116)."""
+
+    def __init__(self, dataset, num_neighbors, input_nodes, batch_size,
+                 buffer_capacity: int = 8, seed: int = 0):
+        from ..loader.node_loader import NeighborLoader
+
+        self.loader = NeighborLoader(dataset, num_neighbors,
+                                     input_nodes, batch_size=batch_size,
+                                     shuffle=True, seed=seed)
+        self.buffer: "queue.Queue" = queue.Queue(maxsize=buffer_capacity)
+        self._thread: Optional[threading.Thread] = None
+
+    def num_expected(self) -> int:
+        return len(self.loader)
+
+    def start_epoch(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("epoch already in progress")
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        from .sample_message import batch_to_message
+
+        for batch in self.loader:
+            self.buffer.put(serialize(batch_to_message(batch)))
+
+    def fetch(self) -> bytes:
+        return self.buffer.get()
+
+
+class DistServer:
+    """Args mirror init_server (dist_server.py:158-190)."""
+
+    def __init__(self, dataset, host: str = "127.0.0.1", port: int = 0):
+        self.dataset = dataset
+        self._producers: Dict[int, _Producer] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.addr = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    # -- request handlers (cf. _call_func_on_server, dist_server.py:214) ---
+    def _handle(self, req: dict):
+        op = req["op"]
+        if op == "get_dataset_meta":
+            g = self.dataset.get_graph()
+            return {"num_nodes": g.num_nodes, "num_edges": g.num_edges}
+        if op == "create_sampling_producer":
+            with self._lock:
+                pid = self._next_id
+                self._next_id += 1
+                self._producers[pid] = _Producer(
+                    self.dataset, req["num_neighbors"],
+                    np.asarray(req["input_nodes"], np.int64),
+                    req["batch_size"],
+                    buffer_capacity=req.get("buffer_capacity", 8),
+                    seed=req.get("seed", 0))
+            return {"producer_id": pid,
+                    "num_expected": self._producers[pid].num_expected()}
+        if op == "start_new_epoch_sampling":
+            self._producers[req["producer_id"]].start_epoch()
+            return {"ok": True}
+        if op == "destroy_sampling_producer":
+            with self._lock:
+                self._producers.pop(req["producer_id"], None)
+            return {"ok": True}
+        if op == "exit":
+            self._stop.set()
+            return {"ok": True}
+        raise ValueError(f"unknown op {op!r}")
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._sock.settimeout(0.2)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                kind, data = recv_frame(conn)
+                if kind is None:
+                    return
+                req = json.loads(data)
+                if req["op"] == "fetch_one_sampled_message":
+                    payload = self._producers[req["producer_id"]].fetch()
+                    send_frame(conn, _KIND_MSG, payload)
+                else:
+                    resp = self._handle(req)
+                    send_frame(conn, _KIND_JSON, json.dumps(resp).encode())
+        except Exception as e:  # connection-scoped errors end the session
+            try:
+                send_frame(conn, _KIND_JSON,
+                           json.dumps({"error": str(e)}).encode())
+            except OSError:
+                pass
+        finally:
+            conn.close()
+
+    def wait_for_exit(self, timeout: Optional[float] = None) -> None:
+        self._stop.wait(timeout)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def init_server(dataset, host: str = "127.0.0.1", port: int = 0
+                ) -> DistServer:
+    return DistServer(dataset, host=host, port=port)

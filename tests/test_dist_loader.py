@@ -65,7 +65,6 @@ def test_collocated_mode():
     assert sorted(seen) == list(range(N))
 
 
-@pytest.mark.timeout(120)
 def test_mp_worker_mode():
     loader = DistNeighborLoader(
         [2, 2], np.arange(N), batch_size=6,

@@ -1,0 +1,58 @@
+"""Server-client deployment tests (cf. test_dist_neighbor_loader.py's
+server-client topology, :173-371): real sockets, real producer threads."""
+import numpy as np
+import pytest
+
+from glt_tpu.distributed.dist_client import RemoteNeighborLoader
+from glt_tpu.distributed.dist_server import init_server
+from tests.test_dist_loader import N, build_ring_dataset, check_batch
+
+
+@pytest.fixture(scope="module")
+def server():
+    ds = build_ring_dataset()
+    srv = init_server(ds)
+    yield srv
+    srv.shutdown()
+
+
+def test_meta(server):
+    from glt_tpu.distributed.dist_client import RemoteServerConnection
+
+    conn = RemoteServerConnection(server.addr)
+    meta = conn.request(op="get_dataset_meta")
+    assert meta["num_nodes"] == N
+    conn.close()
+
+
+def test_remote_loader_epochs(server):
+    loader = RemoteNeighborLoader(server.addr, [2, 2], np.arange(N),
+                                  batch_size=6, prefetch=2)
+    try:
+        assert len(loader) == 4
+        for epoch in range(2):
+            seen = []
+            for batch in loader:
+                check_batch(batch)
+                seen.extend(
+                    np.asarray(batch.batch)[:batch.batch_size].tolist())
+            assert sorted(seen) == list(range(N))
+    finally:
+        loader.shutdown()
+
+
+def test_two_clients_same_server(server):
+    l1 = RemoteNeighborLoader(server.addr, [2], np.arange(0, 12),
+                              batch_size=6)
+    l2 = RemoteNeighborLoader(server.addr, [2], np.arange(12, 24),
+                              batch_size=6)
+    try:
+        s1 = [n for b in l1
+              for n in np.asarray(b.batch)[:b.batch_size].tolist()]
+        s2 = [n for b in l2
+              for n in np.asarray(b.batch)[:b.batch_size].tolist()]
+        assert sorted(s1) == list(range(0, 12))
+        assert sorted(s2) == list(range(12, 24))
+    finally:
+        l1.shutdown()
+        l2.shutdown()
