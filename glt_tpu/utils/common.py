@@ -1,0 +1,72 @@
+"""Hetero sampler-output merge/format helpers.
+
+Mirrors ``graphlearn_torch/python/utils/common.py:65-110``
+(``merge_hetero_sampler_output`` / ``format_hetero_sampler_output``): used
+when per-edge-type partial results (e.g. from distributed hetero sampling)
+must be combined into one :class:`HeteroSamplerOutput`.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from ..sampler.base import HeteroSamplerOutput
+from ..typing import PADDING_ID
+
+
+def _cat(a: Optional[jnp.ndarray], b: Optional[jnp.ndarray]):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return jnp.concatenate([a, b])
+
+
+def merge_hetero_sampler_output(
+    a: HeteroSamplerOutput, b: HeteroSamplerOutput) -> HeteroSamplerOutput:
+    """Concatenate two hetero outputs type-wise (edges keep -1 locality
+    within each source output; callers re-relabel when node lists merge —
+    same contract as the reference's merge)."""
+    def md(da, db):
+        if da is None:
+            return db
+        if db is None:
+            return da
+        out = dict(da)
+        for k, v in db.items():
+            out[k] = _cat(out.get(k), v)
+        return out
+
+    return HeteroSamplerOutput(
+        node=md(a.node, b.node),
+        row=md(a.row, b.row),
+        col=md(a.col, b.col),
+        edge=md(a.edge, b.edge),
+        batch=md(a.batch, b.batch),
+        node_mask=md(a.node_mask, b.node_mask),
+        edge_mask=md(a.edge_mask, b.edge_mask),
+        input_type=a.input_type or b.input_type,
+        metadata=a.metadata or b.metadata,
+    )
+
+
+def format_hetero_sampler_output(
+    out: HeteroSamplerOutput) -> HeteroSamplerOutput:
+    """Drop empty edge-type entries (zero-width arrays), the reference's
+    output tidy-up before building HeteroData."""
+    keep = [et for et, r in out.row.items() if r.shape[0] > 0]
+    pick = lambda d: None if d is None else {k: d[k] for k in keep if k in d}
+    return HeteroSamplerOutput(
+        node=out.node,
+        row=pick(out.row),
+        col=pick(out.col),
+        edge=pick(out.edge),
+        batch=out.batch,
+        node_mask=out.node_mask,
+        edge_mask=pick(out.edge_mask),
+        num_sampled_nodes=out.num_sampled_nodes,
+        num_sampled_edges=pick(out.num_sampled_edges),
+        input_type=out.input_type,
+        metadata=out.metadata,
+    )

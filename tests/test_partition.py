@@ -137,3 +137,47 @@ class TestContiguous:
         out = relabel_rows(rows, rel)
         np.testing.assert_array_equal(out[rel.old2new[0]], [10.])
         np.testing.assert_array_equal(out[rel.old2new[3]], [40.])
+
+
+class TestDistRandomPartitioner:
+    def test_two_rank_partition_roundtrip(self, tmp_path):
+        from glt_tpu.partition.dist_random_partitioner import (
+            DistRandomPartitioner, hash_partition)
+        n = 40
+        ei = ring(n)
+        eids = np.arange(ei.shape[1])
+        feat = np.arange(n, dtype=np.float32)[:, None]
+
+        part = DistRandomPartitioner(str(tmp_path), 3, n, ei.shape[1],
+                                     seed=5)
+        # two ranks each hold half the edges and half the feature rows
+        half_e = ei.shape[1] // 2
+        part.partition_rank_chunk(0, ei[:, :half_e], eids[:half_e],
+                                  node_ids=np.arange(0, 20),
+                                  node_feat=feat[:20])
+        part.partition_rank_chunk(1, ei[:, half_e:], eids[half_e:],
+                                  node_ids=np.arange(20, 40),
+                                  node_feat=feat[20:])
+        part.finalize()
+
+        from glt_tpu.partition import load_partition
+        all_nodes, all_edges = [], 0
+        node_pb = np.load(str(tmp_path / "node_pb.npy"))
+        np.testing.assert_array_equal(
+            node_pb, hash_partition(np.arange(n), 3, 5))
+        for p in range(3):
+            graph, node_feat, _, npb, epb, meta = load_partition(
+                str(tmp_path), p)
+            assert (npb[graph.edge_index[0]] == p).all()
+            np.testing.assert_array_equal(node_feat.feats[:, 0],
+                                          node_feat.ids)
+            all_nodes.extend(node_feat.ids.tolist())
+            all_edges += graph.eids.shape[0]
+        assert sorted(all_nodes) == list(range(n))
+        assert all_edges == ei.shape[1]
+
+    def test_balance(self, tmp_path):
+        from glt_tpu.partition.dist_random_partitioner import hash_partition
+        pb = hash_partition(np.arange(100000), 8, 0)
+        counts = np.bincount(pb)
+        assert counts.min() > 100000 / 8 * 0.9
