@@ -96,12 +96,15 @@ class Feature:
         it before the jitted train step).  Padding rows are zeros.
         """
         if self._cold.shape[0] == 0:
+            from ..ops.gather_pallas import gather_rows
+
             ids = jnp.asarray(ids, jnp.int32)
             valid = ids >= 0
             idx = jnp.where(valid, ids, 0)
             if self._id2index is not None:
                 idx = self._id2index[idx]
-            rows = jnp.take(self._hot, idx, axis=0, mode="clip")
+            # Pallas DMA gather on TPU for wide rows; XLA gather otherwise.
+            rows = gather_rows(self._hot, idx)
             return jnp.where(valid[:, None], rows, 0)
 
         if isinstance(ids, jax.core.Tracer):

@@ -79,3 +79,22 @@ def test_edge_type_helpers():
     assert reverse_edge_type(reverse_edge_type(et)) == et
     # Self-loops keep their relation name.
     assert reverse_edge_type(("p", "cites", "p")) == ("p", "cites", "p")
+
+
+class TestPallasGather:
+    def test_interpret_mode_matches_take(self):
+        import jax.numpy as jnp
+        from glt_tpu.ops.gather_pallas import gather_rows_pallas
+        rng = np.random.default_rng(1)
+        table = jnp.asarray(rng.normal(size=(300, 128)).astype(np.float32))
+        idx = jnp.asarray(rng.integers(-1, 300, 256).astype(np.int32))
+        out = np.asarray(gather_rows_pallas(table, idx, interpret=True))
+        want = np.asarray(table)[np.clip(np.asarray(idx), 0, 299)]
+        np.testing.assert_allclose(out, want)
+
+    def test_gather_rows_fallback_cpu(self):
+        import jax.numpy as jnp
+        from glt_tpu.ops.gather_pallas import gather_rows
+        table = jnp.arange(12, dtype=jnp.float32).reshape(4, 3)
+        out = np.asarray(gather_rows(table, jnp.array([2, 0])))
+        np.testing.assert_allclose(out, np.asarray(table)[[2, 0]])
