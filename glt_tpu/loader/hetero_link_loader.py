@@ -1,0 +1,73 @@
+"""Heterogeneous link-prediction loader.
+
+Rebuild of the reference's hetero ``LinkNeighborLoader`` path
+(loader/link_loader.py hetero branch): seed edges of one edge type drive
+``HeteroNeighborSampler.sample_from_edges`` with binary/triplet negatives;
+metadata carries the local ``edge_label_index`` / triplet indices.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ..data.dataset import Dataset
+from ..sampler.base import EdgeSamplerInput, NegativeSampling
+from ..sampler.hetero_neighbor_sampler import HeteroNeighborSampler
+from ..typing import EdgeType
+from .hetero_neighbor_loader import HeteroNeighborLoader
+from .transform import HeteroBatch, to_hetero_batch
+
+
+class HeteroLinkNeighborLoader(HeteroNeighborLoader):
+    def __init__(
+        self,
+        data: Dataset,
+        num_neighbors,
+        edge_label_index,           # (EdgeType, [2, E] ids)
+        edge_label: Optional[np.ndarray] = None,
+        neg_sampling: Optional[NegativeSampling] = None,
+        batch_size: int = 512,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        prefetch: int = 2,
+        seed: int = 0,
+    ):
+        edge_type, eli = edge_label_index
+        eli = np.asarray(eli)
+        sampler = HeteroNeighborSampler(
+            data.graph, num_neighbors, edge_type[0],
+            batch_size=batch_size, seed=seed)
+        super().__init__(data, num_neighbors,
+                         (edge_type[0], np.arange(eli.shape[1])),
+                         batch_size=batch_size, shuffle=shuffle,
+                         drop_last=drop_last, prefetch=prefetch, seed=seed,
+                         sampler=sampler)
+        self.edge_type: EdgeType = edge_type
+        self.edge_label_index = eli
+        self.edge_label = (None if edge_label is None
+                           else np.asarray(edge_label))
+        self.neg_sampling = neg_sampling
+
+    def __iter__(self) -> Iterator[HeteroBatch]:
+        pending = deque()
+        batches = self._epoch_seed_batches()  # batches of edge positions
+        while True:
+            while len(pending) < self.prefetch:
+                pos = next(batches, None)
+                if pos is None:
+                    break
+                inp = EdgeSamplerInput(
+                    row=self.edge_label_index[0, pos],
+                    col=self.edge_label_index[1, pos],
+                    label=None if self.edge_label is None
+                    else self.edge_label[pos],
+                    input_type=self.edge_type,
+                    neg_sampling=self.neg_sampling)
+                pending.append(
+                    (self.sampler.sample_from_edges(inp), pos.shape[0]))
+            if not pending:
+                return
+            out, npos = pending.popleft()
+            yield self._collate_fn(out, npos)

@@ -11,6 +11,7 @@ kernels, and the same reversed-edge-type output convention
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -21,6 +22,7 @@ from ..data.graph import Graph
 from ..ops.neighbor_sample import sample_neighbors
 from ..ops.unique import unique_first_occurrence
 from ..typing import EdgeType, NodeType, PADDING_ID, reverse_edge_type
+from ..ops.unique import relabel_by_reference
 from .base import BaseSampler, HeteroSamplerOutput, NodeSamplerInput
 from .neighbor_sampler import _pad_ids
 
@@ -28,20 +30,21 @@ from .neighbor_sampler import _pad_ids
 def hetero_hop_widths(
     edge_types: Sequence[EdgeType],
     num_neighbors: Dict[EdgeType, List[int]],
-    input_type: NodeType,
-    batch_size: int,
+    seed_widths: Dict[NodeType, int],
     num_hops: int,
 ) -> Tuple[List[Dict[NodeType, int]], Dict[NodeType, int]]:
     """Static frontier width per (hop, node type) + total capacity per type.
 
     Mirrors the implicit bound of the reference's hetero loop: the hop-``i``
     frontier of type ``t`` is every node of type ``t`` first discovered at
-    hop ``i-1`` across all edge types ending in ``t``.
+    hop ``i-1`` across all edge types ending in ``t``.  ``seed_widths``
+    gives the hop-0 frontier per type (node sampling seeds one type; link
+    sampling seeds the edge's endpoint types).
     """
     ntypes = sorted({et[0] for et in edge_types} | {et[2] for et in edge_types}
-                    | {input_type})
+                    | set(seed_widths))
     widths: List[Dict[NodeType, int]] = [
-        {t: (batch_size if t == input_type else 0) for t in ntypes}]
+        {t: seed_widths.get(t, 0) for t in ntypes}]
     for hop in range(num_hops):
         nxt = {t: 0 for t in ntypes}
         for et in edge_types:
@@ -87,39 +90,42 @@ class HeteroNeighborSampler(BaseSampler):
         self._call_count = 0
 
         self._widths, self._capacity = hetero_hop_widths(
-            self.edge_types, self.num_neighbors, input_type,
-            self.batch_size, self.num_hops)
+            self.edge_types, self.num_neighbors,
+            {input_type: self.batch_size}, self.num_hops)
         self.node_types = sorted(self._capacity.keys())
-        self._sample_jit = jax.jit(self._sample_impl)
+        self._sample_jit = jax.jit(
+            partial(self._sample_impl, self._widths, self._capacity))
+        self._edges_jit = {}
 
     def _next_key(self) -> jax.Array:
         key = jax.random.fold_in(self._base_key, self._call_count)
         self._call_count += 1
         return key
 
-    def _sample_impl(self, graph_arrays, seeds, key):
-        """graph_arrays: dict et -> (indptr, indices, edge_ids)."""
-        widths, cap = self._widths, self._capacity
+    def _sample_impl(self, widths, cap, graph_arrays, seeds_dict, key):
+        """graph_arrays: dict et -> (indptr, indices, edge_ids);
+        seeds_dict: dict ntype -> padded seed ids (hop-0 frontiers)."""
+        node_types = sorted(cap.keys())
 
         node_buf = {
             t: jnp.full((max(cap[t], 1),), PADDING_ID, jnp.int32)
-            for t in self.node_types}
-        count = {t: jnp.zeros((), jnp.int32) for t in self.node_types}
-        frontier = {t: None for t in self.node_types}
+            for t in node_types}
+        count = {t: jnp.zeros((), jnp.int32) for t in node_types}
+        frontier = {t: None for t in node_types}
         frontier_start = {t: jnp.zeros((), jnp.int32)
-                          for t in self.node_types}
+                          for t in node_types}
 
-        u0 = unique_first_occurrence(seeds)
-        t0 = self.input_type
-        node_buf[t0] = node_buf[t0].at[: self.batch_size].set(u0.uniques)
-        count[t0] = u0.count
-        frontier[t0] = u0.uniques
+        for t0, seeds in seeds_dict.items():
+            u0 = unique_first_occurrence(seeds)
+            node_buf[t0] = node_buf[t0].at[: seeds.shape[0]].set(u0.uniques)
+            count[t0] = u0.count
+            frontier[t0] = u0.uniques
 
         rows = {et: [] for et in self.edge_types}
         cols = {et: [] for et in self.edge_types}
         eids = {et: [] for et in self.edge_types}
         emasks = {et: [] for et in self.edge_types}
-        counts_hist = {t: [count[t]] for t in self.node_types}
+        counts_hist = {t: [count[t]] for t in node_types}
 
         keys = jax.random.split(key, self.num_hops * len(self.edge_types))
 
@@ -145,7 +151,7 @@ class HeteroNeighborSampler(BaseSampler):
 
             # 2) per dst type: merge all candidates into the unique buffer
             new_frontier = {}
-            for t in self.node_types:
+            for t in node_types:
                 ets = [et for et in hop_out if et[2] == t]
                 if not ets:
                     continue
@@ -181,14 +187,11 @@ class HeteroNeighborSampler(BaseSampler):
                 count[t] = jnp.minimum(merged.count, buflen)
                 frontier_start[t] = old_count
 
-            for t in self.node_types:
+            for t in node_types:
                 counts_hist[t].append(count[t])
-                if t in new_frontier:
-                    frontier[t] = new_frontier[t]
-                elif t != self.input_type or hop >= 0:
-                    # frontier consumed; only newly discovered nodes expand
-                    if t not in new_frontier:
-                        frontier[t] = None
+                # the hop frontier is consumed; only newly discovered
+                # nodes expand next hop
+                frontier[t] = new_frontier.get(t)
 
         def cat_or_empty(lst, width_hint=1):
             if lst:
@@ -197,13 +200,13 @@ class HeteroNeighborSampler(BaseSampler):
 
         rev = {et: reverse_edge_type(et) for et in self.edge_types}
         out = HeteroSamplerOutput(
-            node={t: node_buf[t] for t in self.node_types},
+            node={t: node_buf[t] for t in node_types},
             row={rev[et]: cat_or_empty(rows[et]) for et in self.edge_types},
             col={rev[et]: cat_or_empty(cols[et]) for et in self.edge_types},
             edge={rev[et]: cat_or_empty(eids[et]) for et in self.edge_types},
-            batch={t0: seeds},
+            batch=dict(seeds_dict),
             node_mask={t: (jnp.arange(node_buf[t].shape[0], dtype=jnp.int32)
-                           < count[t]) for t in self.node_types},
+                           < count[t]) for t in node_types},
             edge_mask={rev[et]: (cat_or_empty(emasks[et]).astype(bool)
                                  if emasks[et] else
                                  jnp.zeros((0,), bool))
@@ -213,8 +216,8 @@ class HeteroNeighborSampler(BaseSampler):
                     [counts_hist[t][0]]
                     + [counts_hist[t][i + 1] - counts_hist[t][i]
                        for i in range(len(counts_hist[t]) - 1)])
-                for t in self.node_types},
-            input_type=t0,
+                for t in node_types},
+            input_type=self.input_type,
         )
         return out
 
@@ -227,8 +230,118 @@ class HeteroNeighborSampler(BaseSampler):
         graph_arrays = {
             et: (g.indptr, g.indices, g.edge_ids)
             for et, g in self.graphs.items()}
-        return self._sample_jit(graph_arrays, jnp.asarray(seeds), key)
+        return self._sample_jit(graph_arrays,
+                                {self.input_type: jnp.asarray(seeds)}, key)
 
-    def sample_from_edges(self, inputs, **kwargs):
-        raise NotImplementedError(
-            "hetero link sampling lands with the hetero link loader")
+    # -- hetero link path (cf. neighbor_sampler.py:255-381 hetero branch) --
+    def sample_from_edges(self, inputs, key: Optional[jax.Array] = None
+                          ) -> HeteroSamplerOutput:
+        """Seed-edge sampling with optional binary/triplet negatives.
+
+        Negatives are drawn non-strict (uniform destination-type nodes),
+        matching the reference's distributed non-strict mode
+        (dist_neighbor_sampler.py:327-453); strict rejection needs the
+        per-type sorted-column view and lands with weighted sampling.
+        """
+        et = inputs.input_type
+        if et is None:
+            raise ValueError("hetero EdgeSamplerInput needs input_type")
+        src_t, _, dst_t = et
+        neg = inputs.neg_sampling
+        q = self.batch_size
+        src = _pad_ids(np.asarray(inputs.row), q)
+        dst = _pad_ids(np.asarray(inputs.col), q)
+        if key is None:
+            key = self._next_key()
+
+        mode = None if neg is None else neg.mode
+        amount = 0 if neg is None else int(round(neg.amount))
+        fn = self._get_edges_jit(et, mode, amount)
+        graph_arrays = {
+            e: (g.indptr, g.indices, g.edge_ids)
+            for e, g in self.graphs.items()}
+        out = fn(graph_arrays, jnp.asarray(src), jnp.asarray(dst), key)
+
+        if mode == "binary":
+            label = inputs.label
+            pos_label = (jnp.ones((q,), jnp.int32) if label is None
+                         else jnp.asarray(_pad_ids(label, q)) + 1)
+            pos_label = jnp.where(jnp.asarray(src) >= 0, pos_label,
+                                  PADDING_ID)
+            out.metadata["edge_label"] = jnp.concatenate(
+                [pos_label, jnp.zeros((q * amount,), jnp.int32)])
+        return out
+
+    def _get_edges_jit(self, et, mode, amount):
+        k = (et, mode, amount)
+        if k not in self._edges_jit:
+            src_t, _, dst_t = et
+            q = self.batch_size
+            if mode == "binary":
+                sw, dw = q * (1 + amount), q * (1 + amount)
+            elif mode == "triplet":
+                sw, dw = q, q * (1 + amount)
+            else:
+                sw, dw = q, q
+            seed_widths = ({src_t: sw + dw} if src_t == dst_t
+                           else {src_t: sw, dst_t: dw})
+            widths, cap = hetero_hop_widths(
+                self.edge_types, self.num_neighbors, seed_widths,
+                self.num_hops)
+
+            # Node counts are static: an edge type's CSR rows are its
+            # source type's nodes.
+            n_src = self.graphs[et].num_nodes
+            dst_rows = [e for e in self.edge_types if e[0] == dst_t]
+            if not dst_rows:
+                raise ValueError(
+                    f"cannot size negatives: no edge type has source type "
+                    f"{dst_t!r} (needed for its node count)")
+            n_dst = self.graphs[dst_rows[0]].num_nodes
+
+            def impl(graph_arrays, src, dst, key):
+                kneg, ksample = jax.random.split(key)
+                if mode == "binary":
+                    ks, kd = jax.random.split(kneg)
+                    neg_src = jax.random.randint(ks, (q * amount,), 0,
+                                                 n_src, dtype=jnp.int32)
+                    neg_dst = jax.random.randint(kd, (q * amount,), 0,
+                                                 n_dst, dtype=jnp.int32)
+                    srcs = jnp.concatenate([src, neg_src])
+                    dsts = jnp.concatenate([dst, neg_dst])
+                elif mode == "triplet":
+                    neg_dst = jax.random.randint(kneg, (q * amount,), 0,
+                                                 n_dst, dtype=jnp.int32)
+                    neg_dst = jnp.where(jnp.repeat(src >= 0, amount),
+                                        neg_dst, PADDING_ID)
+                    srcs, dsts = src, jnp.concatenate([dst, neg_dst])
+                else:
+                    srcs, dsts = src, dst
+
+                if src_t == dst_t:
+                    seeds_dict = {src_t: jnp.concatenate([srcs, dsts])}
+                else:
+                    seeds_dict = {src_t: srcs, dst_t: dsts}
+                out = self._sample_impl(widths, cap, graph_arrays,
+                                        seeds_dict, ksample)
+                meta = {}
+                if mode == "binary":
+                    meta["edge_label_index"] = jnp.stack([
+                        relabel_by_reference(out.node[src_t], srcs),
+                        relabel_by_reference(out.node[dst_t], dsts)])
+                elif mode == "triplet":
+                    meta["src_index"] = relabel_by_reference(
+                        out.node[src_t], src)
+                    meta["dst_pos_index"] = relabel_by_reference(
+                        out.node[dst_t], dst)
+                    meta["dst_neg_index"] = relabel_by_reference(
+                        out.node[dst_t], neg_dst).reshape(q, amount)
+                else:
+                    meta["edge_label_index"] = jnp.stack([
+                        relabel_by_reference(out.node[src_t], src),
+                        relabel_by_reference(out.node[dst_t], dst)])
+                out.metadata = meta
+                return out
+
+            self._edges_jit[k] = jax.jit(impl)
+        return self._edges_jit[k]

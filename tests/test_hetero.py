@@ -144,3 +144,66 @@ class TestRGAT:
                 params, opt_state, loss = step(params, opt_state, batch)
                 losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+class TestHeteroLink:
+    def test_binary_negatives(self):
+        ds = hetero_dataset()
+        samp = HeteroNeighborSampler(ds.graph, [2], "user", batch_size=4)
+        from glt_tpu.sampler import EdgeSamplerInput, NegativeSampling
+        src = np.array([0, 3, 6, 9])
+        dst = src % I
+        inp = EdgeSamplerInput(row=src, col=dst, input_type=ET_UI,
+                               neg_sampling=NegativeSampling("binary", 1))
+        out = samp.sample_from_edges(inp)
+        eli = np.asarray(out.metadata["edge_label_index"])
+        lab = np.asarray(out.metadata["edge_label"])
+        users = np.asarray(out.node["user"])
+        items = np.asarray(out.node["item"])
+        assert eli.shape == (2, 8)
+        for i in range(4):
+            assert users[eli[0, i]] == src[i]
+            assert items[eli[1, i]] == dst[i]
+            assert lab[i] == 1
+        assert (lab[4:] == 0).all()
+        # negatives resolve to valid local item indices
+        assert (eli[1, 4:] >= 0).all()
+
+    def test_triplet(self):
+        ds = hetero_dataset()
+        samp = HeteroNeighborSampler(ds.graph, [2], "user", batch_size=3)
+        from glt_tpu.sampler import EdgeSamplerInput, NegativeSampling
+        src = np.array([1, 4, 7])
+        dst = src % I
+        inp = EdgeSamplerInput(row=src, col=dst, input_type=ET_UI,
+                               neg_sampling=NegativeSampling("triplet", 2))
+        out = samp.sample_from_edges(inp)
+        users = np.asarray(out.node["user"])
+        items = np.asarray(out.node["item"])
+        assert [users[i] for i in np.asarray(out.metadata["src_index"])] \
+            == src.tolist()
+        assert [items[i] for i in np.asarray(out.metadata["dst_pos_index"])] \
+            == dst.tolist()
+        dni = np.asarray(out.metadata["dst_neg_index"])
+        assert dni.shape == (3, 2)
+        assert (dni >= 0).all()
+
+    def test_loader(self):
+        from glt_tpu.loader.hetero_link_loader import HeteroLinkNeighborLoader
+        from glt_tpu.sampler import NegativeSampling
+        ds = hetero_dataset()
+        src = np.arange(U)
+        dst = src % I
+        loader = HeteroLinkNeighborLoader(
+            ds, [2], (ET_UI, np.stack([src, dst])), batch_size=4,
+            neg_sampling=NegativeSampling("binary", 1))
+        n = 0
+        for batch in loader:
+            n += 1
+            eli = np.asarray(batch.metadata["edge_label_index"])
+            assert eli.shape == (2, 8)
+            xu = np.asarray(batch.x["user"])
+            users = np.asarray(batch.node["user"])
+            umask = np.asarray(batch.node_mask["user"])
+            np.testing.assert_allclose(xu[umask][:, 0], users[umask])
+        assert n == 3
