@@ -16,7 +16,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--num-nodes", type=int, default=2_000_000)
+    ap.add_argument("--num-nodes", type=int, default=500_000)
     ap.add_argument("--dim", type=int, default=128)
     ap.add_argument("--batch", type=int, default=100_000)
     ap.add_argument("--split-ratio", type=float, default=1.0)

@@ -1,0 +1,123 @@
+"""Distributed heterogeneous neighbor sampling over a device mesh.
+
+Rebuild of the reference's distributed hetero path
+(dist_neighbor_sampler.py:270-288: all edge-type hop tasks issued
+concurrently, each routed per-partition and stitched).  Here every edge
+type's CSR is sharded by its **source type's** contiguous node ranges, and
+the hetero multi-hop body (:class:`HeteroNeighborSampler`) runs per shard
+with the one-hop primitive swapped for the all-to-all exchange of
+:func:`~glt_tpu.parallel.dist_sampler.exchange_one_hop` — per edge type,
+over the same mesh axis.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..data.topology import CSRTopo
+from ..ops.neighbor_sample import NeighborOutput
+from ..sampler.base import HeteroSamplerOutput, NodeSamplerInput
+from ..sampler.hetero_neighbor_sampler import (
+    HeteroNeighborSampler,
+    hetero_hop_widths,
+)
+from ..typing import EdgeType, NodeType, PADDING_ID
+from .dist_sampler import exchange_one_hop
+from .sharding import ShardedGraph, shard_graph
+
+
+def shard_hetero_graph(topos: Dict[EdgeType, CSRTopo], num_shards: int
+                       ) -> Dict[EdgeType, ShardedGraph]:
+    """Shard every edge type's CSR by its source type's node ranges."""
+    return {et: shard_graph(t, num_shards) for et, t in topos.items()}
+
+
+class DistHeteroNeighborSampler:
+    """Multi-hop distributed hetero sampler.
+
+    Args:
+      sharded: dict ``EdgeType -> ShardedGraph`` (from
+        :func:`shard_hetero_graph`).
+      mesh / axis_name: the device mesh to sample over.
+      num_neighbors / input_type / batch_size: as
+        :class:`HeteroNeighborSampler`.
+    """
+
+    def __init__(self, sharded: Dict[EdgeType, ShardedGraph], mesh: Mesh,
+                 num_neighbors, input_type: NodeType,
+                 batch_size: int = 512, axis_name: str = "shard",
+                 seed: int = 0):
+        self.sharded = sharded
+        self.mesh = mesh
+        self.axis_name = axis_name
+        # Reuse the single-device sampler's planning + multi-hop body; the
+        # Graph objects aren't touched (one_hop is overridden).
+        self._planner = HeteroNeighborSampler.__new__(HeteroNeighborSampler)
+        p = self._planner
+        p.graphs = {et: None for et in sharded}
+        p.edge_types = sorted(sharded.keys())
+        if isinstance(num_neighbors, dict):
+            p.num_neighbors = {et: list(v) for et, v in num_neighbors.items()}
+        else:
+            p.num_neighbors = {et: list(num_neighbors)
+                               for et in p.edge_types}
+        p.num_hops = max(len(v) for v in p.num_neighbors.values())
+        p.input_type = input_type
+        p.batch_size = int(batch_size)
+        self.input_type = input_type
+        self.batch_size = int(batch_size)
+        self._base_key = jax.random.PRNGKey(seed)
+        self._call_count = 0
+
+        self._widths, self._capacity = hetero_hop_widths(
+            p.edge_types, p.num_neighbors, {input_type: self.batch_size},
+            p.num_hops)
+
+        gspec = P(axis_name)
+        arrays = {et: (g.indptr, g.indices, g.edge_ids)
+                  for et, g in sharded.items()}
+        specs = jax.tree.map(lambda _: gspec, arrays)
+        self._shard_fn = jax.jit(jax.shard_map(
+            self._local_body, mesh=mesh,
+            in_specs=(specs, gspec, P()),
+            out_specs=gspec,
+            check_vma=False))
+
+    def _next_key(self) -> jax.Array:
+        key = jax.random.fold_in(self._base_key, self._call_count)
+        self._call_count += 1
+        return key
+
+    def _one_hop(self, et, arrays, frontier, fanout, key):
+        indptr, indices, edge_ids = arrays
+        g = self.sharded[et]
+        nbrs, eids, mask = exchange_one_hop(
+            frontier, indptr, indices, edge_ids, g.nodes_per_shard,
+            g.num_shards, fanout, key, self.axis_name)
+        return NeighborOutput(nbrs=nbrs, eids=eids, mask=mask)
+
+    def _local_body(self, arrays_blk, seeds_blk, key):
+        arrays = jax.tree.map(lambda x: x[0], arrays_blk)
+        seeds = seeds_blk[0]
+        key = jax.random.fold_in(key, lax.axis_index(self.axis_name))
+        out = self._planner._sample_impl(
+            self._widths, self._capacity, arrays,
+            {self.input_type: seeds}, key, one_hop=self._one_hop)
+        return jax.tree.map(lambda x: x[None], out)
+
+    def sample_from_nodes(self, seeds_per_shard: jnp.ndarray,
+                          key: Optional[jax.Array] = None
+                          ) -> HeteroSamplerOutput:
+        """``seeds_per_shard``: ``[S, batch_size]`` global seed ids of the
+        input type, -1 padded; returns per-shard hetero outputs (leading
+        axis = shard)."""
+        if key is None:
+            key = self._next_key()
+        arrays = {et: (g.indptr, g.indices, g.edge_ids)
+                  for et, g in self.sharded.items()}
+        return self._shard_fn(arrays, seeds_per_shard, key)

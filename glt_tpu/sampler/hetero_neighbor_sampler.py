@@ -102,9 +102,13 @@ class HeteroNeighborSampler(BaseSampler):
         self._call_count += 1
         return key
 
-    def _sample_impl(self, widths, cap, graph_arrays, seeds_dict, key):
+    def _sample_impl(self, widths, cap, graph_arrays, seeds_dict, key,
+                     one_hop=None):
         """graph_arrays: dict et -> (indptr, indices, edge_ids);
-        seeds_dict: dict ntype -> padded seed ids (hop-0 frontiers)."""
+        seeds_dict: dict ntype -> padded seed ids (hop-0 frontiers);
+        one_hop: optional override ``(et, arrays, frontier, fanout, key) ->
+        NeighborOutput`` — the distributed sampler plugs its all-to-all
+        exchange here, keeping this multi-hop body single-source."""
         node_types = sorted(cap.keys())
 
         node_buf = {
@@ -138,11 +142,14 @@ class HeteroNeighborSampler(BaseSampler):
                 w = widths[hop][et[0]]
                 if f <= 0 or w <= 0 or frontier[et[0]] is None:
                     continue
-                indptr, indices, edge_ids = graph_arrays[et]
-                out = sample_neighbors(
-                    indptr, indices, frontier[et[0]], f,
-                    keys[hop * len(self.edge_types) + ei_idx],
-                    edge_ids=edge_ids)
+                hop_key = keys[hop * len(self.edge_types) + ei_idx]
+                if one_hop is not None:
+                    out = one_hop(et, graph_arrays[et], frontier[et[0]], f,
+                                  hop_key)
+                else:
+                    indptr, indices, edge_ids = graph_arrays[et]
+                    out = sample_neighbors(indptr, indices, frontier[et[0]],
+                                           f, hop_key, edge_ids=edge_ids)
                 src_local = (frontier_start[et[0]]
                              + jnp.arange(w, dtype=jnp.int32))
                 src_local = jnp.where(frontier[et[0]] >= 0, src_local,

@@ -126,3 +126,39 @@ class TestDistFeature:
                     assert (got[s, k] == 0).all()
                 else:
                     assert (got[s, k] == ids[s, k]).all()
+
+
+class TestDistHeteroSampler:
+    def test_bipartite_two_hop(self, mesh):
+        """user u -> items (u%I, (u+1)%I); item j -> users (j, j+I, ...)."""
+        from glt_tpu.data.topology import CSRTopo
+        from glt_tpu.parallel.dist_hetero_sampler import (
+            DistHeteroNeighborSampler, shard_hetero_graph)
+
+        U, I = 32, 16
+        ET_UI = ("user", "clicks", "item")
+        ET_IU = ("item", "rev_clicks", "user")
+        u_src = np.repeat(np.arange(U), 2)
+        i_dst = np.concatenate([[u % I, (u + 1) % I] for u in range(U)])
+        topos = {
+            ET_UI: CSRTopo(np.stack([u_src, i_dst]), num_nodes=U),
+            ET_IU: CSRTopo(np.stack([i_dst, u_src]), num_nodes=I),
+        }
+        sharded = shard_hetero_graph(topos, N_DEV)
+        samp = DistHeteroNeighborSampler(sharded, mesh, [2, 2], "user",
+                                         batch_size=2)
+        seeds = np.stack([[s * 4, s * 4 + 3] for s in range(N_DEV)]
+                         ).astype(np.int32)
+        out = samp.sample_from_nodes(jnp.asarray(seeds))
+        users = np.asarray(out.node["user"])
+        items = np.asarray(out.node["item"])
+        for s in range(N_DEV):
+            assert users[s, 0] == seeds[s, 0]
+            assert users[s, 1] == seeds[s, 1]
+            m = np.asarray(out.edge_mask[ET_IU][s])
+            row = np.asarray(out.row[ET_IU][s])
+            col = np.asarray(out.col[ET_IU][s])
+            assert m.sum() > 0
+            for r, c in zip(row[m], col[m]):
+                u, it = users[s, c], items[s, r]
+                assert it in ((u % I), ((u + 1) % I))
