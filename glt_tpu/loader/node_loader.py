@@ -133,6 +133,7 @@ class NeighborLoader(NodeLoader):
         prefetch: int = 2,
         seed: int = 0,
         sampler: Optional[NeighborSampler] = None,
+        as_pyg_v1: bool = False,
     ):
         if sampler is None:
             sampler = NeighborSampler(
@@ -142,3 +143,19 @@ class NeighborLoader(NodeLoader):
                          shuffle=shuffle, drop_last=drop_last,
                          prefetch=prefetch, seed=seed)
         self.num_neighbors = list(num_neighbors)
+        self.frontier_cap = frontier_cap
+        self.as_pyg_v1 = as_pyg_v1
+
+    def __iter__(self):
+        if not self.as_pyg_v1:
+            yield from super().__iter__()
+            return
+        # Layered (batch_size, n_id, adjs) protocol
+        # (cf. neighbor_loader.py as_pyg_v1 path).
+        from .transform import as_pyg_v1_adjs
+
+        for batch in super().__iter__():
+            # widths derive from the loader's static batch width, not the
+            # (possibly smaller) trailing batch's seed count
+            yield as_pyg_v1_adjs(batch, self.batch_size,
+                                 self.num_neighbors, self.frontier_cap)

@@ -119,6 +119,29 @@ def to_batch(
     )
 
 
+def as_pyg_v1_adjs(batch: Batch, batch_size: int, fanouts,
+                   frontier_cap=None):
+    """Layered PyG-v1-style output (cf. neighbor_sampler.py:383-407).
+
+    Returns ``(batch_size, n_id, adjs)`` where ``adjs`` is one
+    ``(edge_index, e_id, size)`` triple per hop, outermost hop first (the
+    reversed order PyG v1 models consume).  Per-hop edges are contiguous
+    segments of the batch's padded COO because the sampler concatenates
+    hops in order.
+    """
+    from ..sampler.neighbor_sampler import hop_widths
+
+    widths = hop_widths(batch_size, list(fanouts), frontier_cap)
+    adjs = []
+    lo = 0
+    for w, f in zip(widths, fanouts):
+        hi = lo + w * f
+        adjs.append((batch.edge_index[:, lo:hi], batch.edge_id[lo:hi],
+                     (batch.node.shape[0], batch.node.shape[0])))
+        lo = hi
+    return batch_size, batch.node, list(reversed(adjs))
+
+
 def to_hetero_batch(
     out: HeteroSamplerOutput,
     x: Optional[Dict[NodeType, jnp.ndarray]] = None,

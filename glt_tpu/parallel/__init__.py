@@ -5,10 +5,13 @@ from .dist_sampler import (
     exchange_one_hop,
 )
 from .dist_feature import exchange_gather
+from .dist_hetero_sampler import DistHeteroNeighborSampler, shard_hetero_graph
 from .dist_train import init_dist_state, make_dist_train_step
 
 __all__ = [
+    "DistHeteroNeighborSampler",
     "DistNeighborSampler",
+    "shard_hetero_graph",
     "ShardedFeature",
     "ShardedGraph",
     "dist_sample_multi_hop",

@@ -109,3 +109,23 @@ class TestSubGraphLoader:
             edge_set = set(zip(src_g.tolist(), dst_g.tolist()))
             for r, c in zip(ei[0][m], ei[1][m]):
                 assert (nodes[r], nodes[c]) in edge_set
+
+
+class TestPygV1:
+    def test_layered_adjs(self):
+        ds = make_dataset()
+        loader = NeighborLoader(ds, [2, 3], np.arange(24), batch_size=6,
+                                as_pyg_v1=True)
+        for bs, n_id, adjs in loader:
+            assert bs == 6
+            assert len(adjs) == 2
+            # outermost hop first: widths 6*2=12 edges innermost,
+            # 12*3=36 outermost... reversed => adjs[0] is hop 2
+            assert adjs[0][0].shape == (2, 36)
+            assert adjs[1][0].shape == (2, 12)
+            nodes = np.asarray(n_id)
+            # hop-1 edges connect seeds
+            ei = np.asarray(adjs[1][0])
+            valid = ei[0] >= 0
+            for r, c in zip(ei[0][valid], ei[1][valid]):
+                assert (nodes[r] - nodes[c]) % 24 in (1, 2)
