@@ -152,8 +152,9 @@ def dist_sample_multi_hop(
     cap = max_sampled_nodes(seeds.shape[0], fanouts, frontier_cap)
 
     u0 = unique_first_occurrence(seeds)
-    node_buf = jnp.full((cap,), PADDING_ID, jnp.int32)
-    node_buf = node_buf.at[: widths[0]].set(u0.uniques)
+    # Growing unique buffer (see NeighborSampler._sample_impl): hop i only
+    # sorts what can exist by hop i.
+    node_buf = u0.uniques
     count = u0.count
     frontier = u0.uniques
     frontier_start = jnp.zeros((), jnp.int32)
@@ -172,10 +173,11 @@ def dist_sample_multi_hop(
         src_local = frontier_start + jnp.arange(w, dtype=jnp.int32)
         src_local = jnp.where(frontier >= 0, src_local, PADDING_ID)
 
+        buflen = node_buf.shape[0]
         merged = unique_first_occurrence(
             jnp.concatenate([node_buf, nbrs.ravel()]))
-        new_buf = merged.uniques
-        nbr_local = merged.inverse[cap:].reshape(w, f)
+        node_buf = merged.uniques
+        nbr_local = merged.inverse[buflen:].reshape(w, f)
         nbr_local = jnp.where(mask, nbr_local, PADDING_ID)
 
         rows.append(nbr_local.ravel())
@@ -189,12 +191,18 @@ def dist_sample_multi_hop(
             nw = widths[i + 1]
             frontier = lax.dynamic_slice(
                 jnp.concatenate(
-                    [new_buf, jnp.full((nw,), PADDING_ID, jnp.int32)]),
-                (jnp.clip(count, 0, new_buf.shape[0]),), (nw,))
+                    [node_buf, jnp.full((nw,), PADDING_ID, jnp.int32)]),
+                (jnp.clip(count, 0, node_buf.shape[0]),), (nw,))
             frontier_start = count
-        node_buf = new_buf[:cap]
-        count = jnp.minimum(new_count, cap)
+        count = new_count
         counts_per_hop.append(count)
+
+    if node_buf.shape[0] < cap:
+        node_buf = jnp.concatenate(
+            [node_buf,
+             jnp.full((cap - node_buf.shape[0],), PADDING_ID, jnp.int32)])
+    node_buf = node_buf[:cap]
+    count = jnp.minimum(count, cap)
 
     num_sampled_nodes = jnp.stack(
         [counts_per_hop[0]]

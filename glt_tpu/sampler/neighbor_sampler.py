@@ -134,8 +134,11 @@ class NeighborSampler(BaseSampler):
         cap = self.node_capacity
 
         u0 = unique_first_occurrence(seeds)
-        node_buf = jnp.full((cap,), PADDING_ID, jnp.int32)
-        node_buf = node_buf.at[: widths[0]].set(u0.uniques)
+        # The unique buffer GROWS hop by hop (static per-hop sizes) instead
+        # of being pre-padded to the final capacity: hop i sorts only
+        # O(nodes discoverable by hop i) keys, cutting total sort work
+        # ~2.6x for [15,10,5]-style fanouts.
+        node_buf = u0.uniques                # [widths[0]], -1 padded
         count = u0.count                     # valid uniques so far
         frontier = u0.uniques                # [widths[0]]
         frontier_start = jnp.zeros((), jnp.int32)
@@ -153,12 +156,13 @@ class NeighborSampler(BaseSampler):
             src_local = frontier_start + jnp.arange(w, dtype=jnp.int32)
             src_local = jnp.where(frontier >= 0, src_local, PADDING_ID)
 
-            # Insert this hop's neighbors into the cumulative unique list.
+            # Insert this hop's neighbors into the cumulative unique list;
+            # old uniques provably keep their positions (they occur first).
+            buflen = node_buf.shape[0]
             cand = out.nbrs.ravel()                        # [w*f]
-            # Concat full buffer + candidates; old uniques keep positions.
             merged = unique_first_occurrence(jnp.concatenate([node_buf, cand]))
-            new_buf = merged.uniques[:cap + w * f]
-            nbr_local = merged.inverse[cap:].reshape(w, f)  # cand segment
+            node_buf = merged.uniques                      # [buflen + w*f]
+            nbr_local = merged.inverse[buflen:].reshape(w, f)
             nbr_local = jnp.where(out.mask, nbr_local, PADDING_ID)
 
             rows.append(nbr_local.ravel())
@@ -172,13 +176,21 @@ class NeighborSampler(BaseSampler):
                 nw = widths[i + 1]
                 frontier = jax.lax.dynamic_slice(
                     jnp.concatenate(
-                        [new_buf,
+                        [node_buf,
                          jnp.full((nw,), PADDING_ID, jnp.int32)]),
-                    (jnp.clip(count, 0, new_buf.shape[0]),), (nw,))
+                    (jnp.clip(count, 0, node_buf.shape[0]),), (nw,))
                 frontier_start = count
-            node_buf = new_buf[:cap]
-            count = jnp.minimum(new_count, cap)
+            count = new_count
             counts_per_hop.append(count)
+
+        # Pad the final buffer to the static capacity.
+        if node_buf.shape[0] < cap:
+            node_buf = jnp.concatenate(
+                [node_buf,
+                 jnp.full((cap - node_buf.shape[0],), PADDING_ID,
+                          jnp.int32)])
+        node_buf = node_buf[:cap]
+        count = jnp.minimum(count, cap)
 
         num_sampled_nodes = jnp.stack(
             [counts_per_hop[0]]
