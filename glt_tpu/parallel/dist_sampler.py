@@ -128,6 +128,74 @@ def exchange_one_hop(
     return nbrs, eids, nbrs >= 0
 
 
+def exchange_one_hop_ring(
+    seeds: jnp.ndarray,
+    indptr: jnp.ndarray,
+    indices: jnp.ndarray,
+    edge_ids: jnp.ndarray,
+    nodes_per_shard: int,
+    num_shards: int,
+    fanout: int,
+    key: jax.Array,
+    axis_name: str,
+):
+    """Ring-pipelined variant of :func:`exchange_one_hop`.
+
+    Instead of one all-to-all burst, request buckets rotate around the ring
+    with ``lax.ppermute`` (the ring-attention software-pipeline pattern):
+    at step ``k`` each shard samples the requests of the shard ``k`` hops
+    upstream while the next buckets are in flight.  Same result, different
+    collective shape — preferable when the mesh axis spans DCN links or
+    when overlapping sampling compute with transfers matters more than
+    burst bandwidth.
+    """
+    b = seeds.shape[0]
+    my = lax.axis_index(axis_name)
+    owner = jnp.where(seeds >= 0, seeds // nodes_per_shard, -1)
+    routing = _bucket_by_owner(seeds, owner, num_shards, cap=b)
+
+    def local_sample(ids, k):
+        local = jnp.where(ids >= 0, ids - my * nodes_per_shard, -1)
+        local = jnp.where((local >= 0) & (local < nodes_per_shard), local, -1)
+        return sample_neighbors(indptr, indices, local, fanout,
+                                jax.random.fold_in(key, k),
+                                edge_ids=edge_ids)
+
+    right = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+
+    # The request matrix and its answer buffers travel the ring together:
+    # after k rotations shard i holds the matrix that originated at shard
+    # i-k and serves ITS row i (the requests shard i-k addressed to i).
+    # After a final rotation (num_shards total) every matrix is home with
+    # all rows answered — one serve + one hop per step, fully pipelined.
+    reqs = routing.buckets.reshape(num_shards, b)
+    ans_n = jnp.full((num_shards, b, fanout), PADDING_ID, jnp.int32)
+    ans_e = jnp.full((num_shards, b, fanout), PADDING_ID, jnp.int32)
+
+    def serve(reqs, ans_n, ans_e, k):
+        incoming = jnp.take(reqs, my, axis=0)
+        o = local_sample(incoming, k)
+        return ans_n.at[my].set(o.nbrs), ans_e.at[my].set(o.eids)
+
+    ans_n, ans_e = serve(reqs, ans_n, ans_e, 0)
+    for k in range(1, num_shards):
+        reqs = lax.ppermute(reqs, axis_name, right)
+        ans_n = lax.ppermute(ans_n, axis_name, right)
+        ans_e = lax.ppermute(ans_e, axis_name, right)
+        ans_n, ans_e = serve(reqs, ans_n, ans_e, k)
+    if num_shards > 1:
+        ans_n = lax.ppermute(ans_n, axis_name, right)
+        ans_e = lax.ppermute(ans_e, axis_name, right)
+
+    resp_nbrs = ans_n.reshape(num_shards * b, fanout)
+    resp_eids = ans_e.reshape(num_shards * b, fanout)
+    nbrs = jnp.where(routing.valid[:, None], resp_nbrs[routing.slot],
+                     PADDING_ID)
+    eids = jnp.where(routing.valid[:, None], resp_eids[routing.slot],
+                     PADDING_ID)
+    return nbrs, eids, nbrs >= 0
+
+
 def dist_sample_multi_hop(
     indptr: jnp.ndarray,
     indices: jnp.ndarray,
@@ -139,14 +207,18 @@ def dist_sample_multi_hop(
     num_shards: int,
     axis_name: str,
     frontier_cap: Optional[int] = None,
+    collective: str = "all_to_all",
 ) -> SamplerOutput:
     """Per-shard multi-hop sampling body; call inside ``shard_map``.
 
     Identical structure to the single-device
     ``NeighborSampler._sample_impl`` — frontier, cumulative
     first-occurrence dedup, relabeled COO — with
-    :func:`exchange_one_hop` as the one-hop primitive.
+    :func:`exchange_one_hop` (or its ring variant, ``collective='ring'``)
+    as the one-hop primitive.
     """
+    exchange = (exchange_one_hop if collective == "all_to_all"
+                else exchange_one_hop_ring)
     fanouts = list(num_neighbors)
     widths = hop_widths(seeds.shape[0], fanouts, frontier_cap)
     cap = max_sampled_nodes(seeds.shape[0], fanouts, frontier_cap)
@@ -166,7 +238,7 @@ def dist_sample_multi_hop(
 
     for i, f in enumerate(fanouts):
         w = widths[i]
-        nbrs, eids, mask = exchange_one_hop(
+        nbrs, eids, mask = exchange(
             frontier, indptr, indices, edge_ids, nodes_per_shard,
             num_shards, f, keys[i], axis_name)
 
@@ -236,7 +308,9 @@ class DistNeighborSampler:
                  num_neighbors: Sequence[int] = (15, 10, 5),
                  batch_size: int = 512,
                  frontier_cap: Optional[int] = None,
+                 collective: str = "all_to_all",
                  seed: int = 0):
+        self.collective = collective
         self.g = sharded_graph
         self.mesh = mesh
         self.axis_name = axis_name
@@ -274,7 +348,7 @@ class DistNeighborSampler:
         out = dist_sample_multi_hop(
             indptr_blk[0], indices_blk[0], eids_blk[0], seeds_blk[0], key,
             self.num_neighbors, self.g.nodes_per_shard, self.g.num_shards,
-            self.axis_name, self.frontier_cap)
+            self.axis_name, self.frontier_cap, self.collective)
         # Re-add the shard axis for shard_map's out_specs.
         return jax.tree.map(lambda x: x[None], out)
 

@@ -162,3 +162,26 @@ class TestDistHeteroSampler:
             for r, c in zip(row[m], col[m]):
                 u, it = users[s, c], items[s, r]
                 assert it in ((u % I), ((u + 1) % I))
+
+
+class TestRingExchange:
+    def test_ring_matches_semantics(self, mesh):
+        """Ring collective yields the same (valid, complete) neighborhoods
+        as the all-to-all exchange on a degree==fanout graph."""
+        n = 64
+        sg = shard_graph(ring_topo(n), N_DEV)
+        samp = DistNeighborSampler(sg, mesh, num_neighbors=[2],
+                                   batch_size=4, collective="ring", seed=3)
+        seeds = np.zeros((N_DEV, 4), np.int32)
+        for s in range(N_DEV):
+            seeds[s] = [(s * 8 + 5 + k * 11) % n for k in range(4)]
+        out = samp.sample_from_nodes(jnp.asarray(seeds))
+        node = np.asarray(out.node)
+        row = np.asarray(out.row)
+        col = np.asarray(out.col)
+        emask = np.asarray(out.edge_mask)
+        for s in range(N_DEV):
+            for b, seed in enumerate(seeds[s]):
+                got = sorted(node[s, row[s, e]] for e in np.where(emask[s])[0]
+                             if node[s, col[s, e]] == seed)
+                assert got == sorted([(seed + 1) % n, (seed + 2) % n])
