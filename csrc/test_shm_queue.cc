@@ -1,0 +1,96 @@
+// Native unit tests for the shm ring queue (cf. test/cpp/test_shm_queue.cu
+// in the reference). Plain asserts, exit 0 on success; driven by
+// tests/test_channel.py::TestNativeBinary.
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <sys/wait.h>
+#include <unistd.h>
+
+extern "C" {
+void* glt_shmq_create(const char* name, uint64_t capacity);
+void* glt_shmq_attach(const char* name);
+int glt_shmq_enqueue(void* q, const void* data, uint64_t size);
+int64_t glt_shmq_dequeue(void* q, void* out, uint64_t out_cap);
+uint64_t glt_shmq_msg_count(void* q);
+void glt_shmq_close(void* q);
+int glt_shmq_unlink(const char* name);
+}
+
+static const char* kName = "/glt_cpp_test_q";
+
+void test_basic() {
+  void* q = glt_shmq_create(kName, 4096);
+  assert(q);
+  const char* msg = "hello tpu";
+  assert(glt_shmq_enqueue(q, msg, 10) == 0);
+  assert(glt_shmq_msg_count(q) == 1);
+  char buf[64];
+  int64_t n = glt_shmq_dequeue(q, buf, sizeof(buf));
+  assert(n == 10);
+  assert(memcmp(buf, msg, 10) == 0);
+  assert(glt_shmq_msg_count(q) == 0);
+  glt_shmq_close(q);
+  glt_shmq_unlink(kName);
+}
+
+void test_wraparound() {
+  void* q = glt_shmq_create(kName, 256);
+  assert(q);
+  char data[100], out[128];
+  for (int round = 0; round < 50; ++round) {
+    memset(data, round & 0xff, sizeof(data));
+    assert(glt_shmq_enqueue(q, data, sizeof(data)) == 0);
+    int64_t n = glt_shmq_dequeue(q, out, sizeof(out));
+    assert(n == 100);
+    for (int i = 0; i < 100; ++i) assert((out[i] & 0xff) == (round & 0xff));
+  }
+  glt_shmq_close(q);
+  glt_shmq_unlink(kName);
+}
+
+void test_too_big_rejected() {
+  void* q = glt_shmq_create(kName, 128);
+  char data[256];
+  assert(glt_shmq_enqueue(q, data, sizeof(data)) == -1);
+  glt_shmq_close(q);
+  glt_shmq_unlink(kName);
+}
+
+void test_cross_process() {
+  void* q = glt_shmq_create(kName, 1 << 16);
+  assert(q);
+  pid_t pid = fork();
+  if (pid == 0) {  // child: producer attaches by name
+    void* cq = glt_shmq_attach(kName);
+    if (!cq) _exit(1);
+    for (uint32_t i = 0; i < 100; ++i) {
+      if (glt_shmq_enqueue(cq, &i, sizeof(i)) != 0) _exit(2);
+    }
+    glt_shmq_close(cq);
+    _exit(0);
+  }
+  for (uint32_t i = 0; i < 100; ++i) {
+    uint32_t v = 0;
+    int64_t n = glt_shmq_dequeue(q, &v, sizeof(v));
+    assert(n == sizeof(v));
+    assert(v == i);
+  }
+  int status = 0;
+  waitpid(pid, &status, 0);
+  assert(WIFEXITED(status) && WEXITSTATUS(status) == 0);
+  glt_shmq_close(q);
+  glt_shmq_unlink(kName);
+}
+
+int main() {
+  glt_shmq_unlink(kName);  // clean any stale segment
+  test_basic();
+  test_wraparound();
+  test_too_big_rejected();
+  test_cross_process();
+  printf("all native shm queue tests passed\n");
+  return 0;
+}

@@ -23,8 +23,12 @@ from .sample_message import message_to_batch
 
 
 class RemoteServerConnection:
-    def __init__(self, addr: Tuple[str, int]):
-        self.sock = socket.create_connection(addr)
+    def __init__(self, addr: Tuple[str, int],
+                 timeout: Optional[float] = 120.0):
+        # Bounded waits so a dead server surfaces as an error instead of a
+        # hang (the reference's RPC timeouts, dist_options.py rpc_timeout).
+        self.sock = socket.create_connection(addr, timeout=timeout)
+        self.sock.settimeout(timeout)
         self._lock = threading.Lock()
 
     def request(self, **req) -> dict:

@@ -91,18 +91,31 @@ class MpSamplingProducer:
         self._workers = []
         self._builder = (dataset_builder, builder_args, list(num_neighbors))
 
-    def init(self) -> None:
+    def _spawn(self, w: int):
         builder, args, nn = self._builder
+        tq = self._ctx.Queue()
+        p = self._ctx.Process(
+            target=_sampling_worker_loop,
+            args=(w, builder, args, nn, self.batch_size, self.channel,
+                  tq, self.options.worker_seed),
+            daemon=True)
+        p.start()
+        return p, tq
+
+    def init(self) -> None:
         for w in range(self.options.num_workers):
-            tq = self._ctx.Queue()
-            p = self._ctx.Process(
-                target=_sampling_worker_loop,
-                args=(w, builder, args, nn, self.batch_size, self.channel,
-                      tq, self.options.worker_seed),
-                daemon=True)
-            p.start()
+            p, tq = self._spawn(w)
             self._task_queues.append(tq)
             self._workers.append(p)
+
+    def _ensure_alive(self) -> None:
+        """Restart dead workers (failure handling the reference lacks,
+        SURVEY §5: its mp workers die silently and the epoch hangs)."""
+        for w, p in enumerate(self._workers):
+            if not p.is_alive():
+                p, tq = self._spawn(w)
+                self._workers[w] = p
+                self._task_queues[w] = tq
 
     def num_expected(self) -> int:
         n = self.input_nodes.shape[0]
@@ -111,6 +124,7 @@ class MpSamplingProducer:
     def produce_all(self) -> None:
         """Kick one epoch: split seeds batch-aligned across workers
         (cf. dist_sampling_producer.py:229-247)."""
+        self._ensure_alive()
         ids = self.input_nodes
         if self.shuffle:
             ids = ids[self._rng.permutation(ids.shape[0])]

@@ -92,3 +92,23 @@ class TestCrossProcess:
             assert p.exitcode == 0
         finally:
             ch.close()
+
+
+class TestNativeBinary:
+    def test_cpp_unit_tests(self, tmp_path):
+        """Build + run the C++ test binary (the reference's test/cpp
+        pattern, scripts/run_cpp_ut.sh)."""
+        import os
+        import subprocess
+        csrc = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "csrc")
+        exe = str(tmp_path / "test_shm_queue")
+        subprocess.run(
+            ["g++", "-O1", "-pthread", "-std=c++17",
+             os.path.join(csrc, "shm_queue.cc"),
+             os.path.join(csrc, "test_shm_queue.cc"),
+             "-o", exe, "-lrt"],
+            check=True, capture_output=True)
+        out = subprocess.run([exe], check=True, capture_output=True,
+                             timeout=60)
+        assert b"all native shm queue tests passed" in out.stdout
