@@ -71,8 +71,13 @@ class _Producer:
         return len(self.loader)
 
     def start_epoch(self) -> None:
-        if self._thread is not None and self._thread.is_alive():
-            raise RuntimeError("epoch already in progress")
+        if self._thread is not None:
+            # The previous epoch's producer may still be draining its last
+            # put even after the client consumed every batch — wait for it
+            # rather than racing.
+            self._thread.join(timeout=60)
+            if self._thread.is_alive():
+                raise RuntimeError("previous epoch still producing")
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
