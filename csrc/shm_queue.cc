@@ -20,7 +20,9 @@
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <ctime>
 
 #include <fcntl.h>
 #include <pthread.h>
@@ -30,15 +32,21 @@
 
 namespace {
 
+// head/tail are MONOTONIC byte offsets (reduced mod capacity only when
+// indexing the ring): bytes-in-ring is always tail - head and "queue
+// non-empty" is head != tail, so neither needs its own field.  That makes
+// each queue operation a SINGLE committing store (tail += ... or
+// head += ...), which is what lets robust-mutex recovery after a producer
+// dies mid-critical-section be sound: any death before the commit store
+// leaves fully consistent state (at worst one fully written but
+// unpublished message past tail, which the next enqueue overwrites).
 struct Header {
   pthread_mutex_t mu;
   pthread_cond_t not_full;
   pthread_cond_t not_empty;
   uint64_t capacity;   // ring bytes
-  uint64_t head;       // read offset  (mod capacity)
-  uint64_t tail;       // write offset (mod capacity)
-  uint64_t used;       // bytes in ring
-  uint64_t msg_count;
+  uint64_t head;       // monotonic read offset
+  uint64_t tail;       // monotonic write offset
   uint32_t magic;
 };
 
@@ -71,6 +79,44 @@ void ring_read(Queue* q, uint64_t pos, void* dst, uint64_t len) {
   }
 }
 
+// Lock handling robust-mutex owner death: every queue operation publishes
+// with a single store to head or tail (see Header comment), so a process
+// killed anywhere inside the critical section leaves consistent state —
+// mark the mutex consistent and continue.
+int q_lock(Header* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+int q_timedwait(pthread_cond_t* cv, Header* h, int timeout_ms) {
+  if (timeout_ms < 0) {
+    int rc = pthread_cond_wait(cv, &h->mu);
+    if (rc == EOWNERDEAD) {
+      pthread_mutex_consistent(&h->mu);
+      rc = 0;
+    }
+    return rc;
+  }
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) {
+    ts.tv_sec += 1;
+    ts.tv_nsec -= 1000000000L;
+  }
+  int rc = pthread_cond_timedwait(cv, &h->mu, &ts);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
 }  // namespace
 
 extern "C" {
@@ -99,14 +145,19 @@ void* glt_shmq_create(const char* name, uint64_t capacity) {
   pthread_mutexattr_t ma;
   pthread_mutexattr_init(&ma);
   pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  // Robust: a sampling worker killed while holding the lock must not wedge
+  // the trainer (the reference's SysV semaphores have the same failure
+  // mode and no recovery).
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
   pthread_mutex_init(&q->hdr->mu, &ma);
   pthread_condattr_t ca;
   pthread_condattr_init(&ca);
   pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
   pthread_cond_init(&q->hdr->not_full, &ca);
   pthread_cond_init(&q->hdr->not_empty, &ca);
   q->hdr->capacity = capacity;
-  q->hdr->head = q->hdr->tail = q->hdr->used = q->hdr->msg_count = 0;
+  q->hdr->head = q->hdr->tail = 0;
   q->hdr->magic = kMagic;
   return q;
 }
@@ -145,15 +196,13 @@ int glt_shmq_enqueue(void* qp, const void* data, uint64_t size) {
   Header* h = q->hdr;
   uint64_t need = size + sizeof(uint64_t);
   if (need > h->capacity) return -1;
-  pthread_mutex_lock(&h->mu);
-  while (h->capacity - h->used < need) {
-    pthread_cond_wait(&h->not_full, &h->mu);
+  q_lock(h);
+  while (h->capacity - (h->tail - h->head) < need) {
+    q_timedwait(&h->not_full, h, -1);
   }
   ring_write(q, h->tail, &size, sizeof(uint64_t));
   ring_write(q, h->tail + sizeof(uint64_t), data, size);
-  h->tail += need;
-  h->used += need;
-  h->msg_count += 1;
+  h->tail += need;  // single commit store
   pthread_cond_signal(&h->not_empty);
   pthread_mutex_unlock(&h->mu);
   return 0;
@@ -163,9 +212,9 @@ int glt_shmq_enqueue(void* qp, const void* data, uint64_t size) {
 uint64_t glt_shmq_next_size(void* qp) {
   Queue* q = static_cast<Queue*>(qp);
   Header* h = q->hdr;
-  pthread_mutex_lock(&h->mu);
-  while (h->msg_count == 0) {
-    pthread_cond_wait(&h->not_empty, &h->mu);
+  q_lock(h);
+  while (h->head == h->tail) {
+    q_timedwait(&h->not_empty, h, -1);
   }
   uint64_t size;
   ring_read(q, h->head, &size, sizeof(uint64_t));
@@ -178,9 +227,9 @@ uint64_t glt_shmq_next_size(void* qp) {
 int64_t glt_shmq_dequeue(void* qp, void* out, uint64_t out_cap) {
   Queue* q = static_cast<Queue*>(qp);
   Header* h = q->hdr;
-  pthread_mutex_lock(&h->mu);
-  while (h->msg_count == 0) {
-    pthread_cond_wait(&h->not_empty, &h->mu);
+  q_lock(h);
+  while (h->head == h->tail) {
+    q_timedwait(&h->not_empty, h, -1);
   }
   uint64_t size;
   ring_read(q, h->head, &size, sizeof(uint64_t));
@@ -189,21 +238,69 @@ int64_t glt_shmq_dequeue(void* qp, void* out, uint64_t out_cap) {
     return -1;
   }
   ring_read(q, h->head + sizeof(uint64_t), out, size);
-  h->head += size + sizeof(uint64_t);
-  h->used -= size + sizeof(uint64_t);
-  h->msg_count -= 1;
+  h->head += size + sizeof(uint64_t);  // single commit store
   pthread_cond_signal(&h->not_full);
   pthread_mutex_unlock(&h->mu);
   return static_cast<int64_t>(size);
 }
 
 uint64_t glt_shmq_msg_count(void* qp) {
+  // Message count is derived by walking the frame headers between head and
+  // tail (queues hold few MB-scale messages, so the walk is trivial); it
+  // is no longer authoritative state that could be torn by owner death.
   Queue* q = static_cast<Queue*>(qp);
-  pthread_mutex_lock(&q->hdr->mu);
-  uint64_t n = q->hdr->msg_count;
-  pthread_mutex_unlock(&q->hdr->mu);
+  Header* h = q->hdr;
+  q_lock(h);
+  uint64_t n = 0;
+  for (uint64_t pos = h->head; pos != h->tail;) {
+    uint64_t size;
+    ring_read(q, pos, &size, sizeof(uint64_t));
+    pos += size + sizeof(uint64_t);
+    ++n;
+  }
+  pthread_mutex_unlock(&h->mu);
   return n;
 }
+
+// Atomic size+payload dequeue with optional timeout: allocates the exact
+// message size under the lock, so concurrent consumers can never race a
+// next_size/dequeue pair (the reference's SampleQueue has the same
+// single-critical-section contract).  timeout_ms < 0 blocks forever;
+// returns 0 on success (*out malloc'd, caller frees via glt_shmq_buf_free),
+// 1 on timeout, -1 on error.
+int glt_shmq_dequeue_alloc(void* qp, uint8_t** out, uint64_t* out_size,
+                           int timeout_ms) {
+  Queue* q = static_cast<Queue*>(qp);
+  Header* h = q->hdr;
+  q_lock(h);
+  while (h->head == h->tail) {
+    int rc = q_timedwait(&h->not_empty, h, timeout_ms);
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return 1;
+    }
+    if (rc != 0) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+  uint64_t size;
+  ring_read(q, h->head, &size, sizeof(uint64_t));
+  uint8_t* buf = static_cast<uint8_t*>(malloc(size ? size : 1));
+  if (buf == nullptr) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  ring_read(q, h->head + sizeof(uint64_t), buf, size);
+  h->head += size + sizeof(uint64_t);  // single commit store
+  pthread_cond_signal(&h->not_full);
+  pthread_mutex_unlock(&h->mu);
+  *out = buf;
+  *out_size = size;
+  return 0;
+}
+
+void glt_shmq_buf_free(uint8_t* buf) { free(buf); }
 
 void glt_shmq_close(void* qp) {
   Queue* q = static_cast<Queue*>(qp);

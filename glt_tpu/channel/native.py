@@ -44,6 +44,13 @@ def lib() -> ctypes.CDLL:
                                            ctypes.c_uint64]
             L.glt_shmq_msg_count.restype = ctypes.c_uint64
             L.glt_shmq_msg_count.argtypes = [ctypes.c_void_p]
+            L.glt_shmq_dequeue_alloc.restype = ctypes.c_int
+            L.glt_shmq_dequeue_alloc.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+            L.glt_shmq_buf_free.restype = None
+            L.glt_shmq_buf_free.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8)]
             L.glt_shmq_close.restype = None
             L.glt_shmq_close.argtypes = [ctypes.c_void_p]
             L.glt_shmq_unlink.restype = ctypes.c_int

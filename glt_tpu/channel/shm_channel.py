@@ -47,13 +47,34 @@ class ShmChannel(ChannelBase):
                 f"message of {len(data)} bytes exceeds channel capacity "
                 f"{self.capacity}")
 
-    def recv(self) -> SampleMessage:
-        size = self._lib.glt_shmq_next_size(self._q)
-        buf = ctypes.create_string_buffer(size)
-        got = self._lib.glt_shmq_dequeue(self._q, buf, size)
-        if got < 0:
+    def recv(self, timeout: Optional[float] = None
+             ) -> Optional[SampleMessage]:
+        """Dequeue one message; block up to ``timeout`` seconds.
+
+        ``timeout=None`` blocks forever; on timeout returns ``None``.
+        Size-peek + payload-copy happen in one native critical section
+        (``glt_shmq_dequeue_alloc``), so multiple consumers on one queue
+        are actually MPMC-safe (a separate next_size/dequeue pair lets
+        another consumer steal the message in between).
+        """
+        timeout_ms = -1 if timeout is None else max(0, int(timeout * 1000))
+        buf = ctypes.POINTER(ctypes.c_uint8)()
+        size = ctypes.c_uint64()
+        rc = self._lib.glt_shmq_dequeue_alloc(
+            self._q, ctypes.byref(buf), ctypes.byref(size), timeout_ms)
+        if rc == 1:
+            return None
+        if rc != 0:
             raise RuntimeError("shm dequeue failed")
-        return deserialize(memoryview(buf)[:got])
+        try:
+            # Zero-copy view over the malloc'd buffer; deserialize copies
+            # each array out of the view, so freeing afterwards is safe.
+            view = memoryview(
+                (ctypes.c_uint8 * size.value).from_address(
+                    ctypes.addressof(buf.contents))).cast("B")
+            return deserialize(view)
+        finally:
+            self._lib.glt_shmq_buf_free(buf)
 
     def empty(self) -> bool:
         return self._lib.glt_shmq_msg_count(self._q) == 0

@@ -71,10 +71,11 @@ class DistNeighborLoader:
         if self._inner is not None:
             yield from self._inner
             return
-        # epoch protocol (cf. dist_loader.py:259-272)
+        # epoch protocol (cf. dist_loader.py:259-272); iter_messages
+        # survives mid-epoch worker death (recv heartbeat + seed reissue).
         self._producer.produce_all()
-        for _ in range(self._producer.num_expected()):
-            yield message_to_batch(self.channel.recv())
+        for msg in self._producer.iter_messages():
+            yield message_to_batch(msg)
 
     def __len__(self) -> int:
         if self._inner is not None:

@@ -27,3 +27,6 @@ class MpSamplingWorkerOptions:
     num_workers: int = 2
     channel_capacity_bytes: int = 64 * 1024 * 1024
     worker_seed: int = 0
+    # Trainer-side recv timeout (seconds) between worker-liveness checks;
+    # bounds how long a mid-epoch worker death can stall the epoch.
+    heartbeat_secs: float = 5.0

@@ -26,6 +26,8 @@ from .sample_message import batch_to_message
 _CMD_SAMPLE_EPOCH = 0
 _CMD_STOP = 1
 
+_WORKER_KEY = "#worker"
+
 
 def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
                           num_neighbors, batch_size, channel, task_queue,
@@ -57,7 +59,11 @@ def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
             seeds = seeds_chunk[lo: lo + batch_size]
             out = sampler.sample_from_nodes(NodeSamplerInput(seeds))
             batch = collate_loader._collate_fn(out, seeds.shape[0])
-            channel.send(batch_to_message(batch))
+            msg = batch_to_message(batch)
+            # Provenance tag so the trainer can attribute delivered batches
+            # per worker and reissue a dead worker's unfinished seed range.
+            msg[_WORKER_KEY] = np.array([worker_id], np.int64)
+            channel.send(msg)
 
 
 class MpSamplingProducer:
@@ -89,7 +95,10 @@ class MpSamplingProducer:
         self._ctx = mp.get_context("spawn")
         self._task_queues = []
         self._workers = []
+        self._chunks = []
+        self._delivered = []
         self._builder = (dataset_builder, builder_args, list(num_neighbors))
+        self.max_respawns = 3
 
     def _spawn(self, w: int):
         builder, args, nn = self._builder
@@ -108,14 +117,17 @@ class MpSamplingProducer:
             self._task_queues.append(tq)
             self._workers.append(p)
 
+    def _respawn(self, w: int) -> None:
+        p, tq = self._spawn(w)
+        self._workers[w] = p
+        self._task_queues[w] = tq
+
     def _ensure_alive(self) -> None:
         """Restart dead workers (failure handling the reference lacks,
         SURVEY §5: its mp workers die silently and the epoch hangs)."""
         for w, p in enumerate(self._workers):
             if not p.is_alive():
-                p, tq = self._spawn(w)
-                self._workers[w] = p
-                self._task_queues[w] = tq
+                self._respawn(w)
 
     def num_expected(self) -> int:
         n = self.input_nodes.shape[0]
@@ -131,10 +143,73 @@ class MpSamplingProducer:
         k = max(1, len(self._workers))
         batches_per_worker = (self.num_expected() + k - 1) // k
         span = batches_per_worker * self.batch_size
+        self._chunks = []
+        self._delivered = []
         for w, tq in enumerate(self._task_queues):
             chunk = ids[w * span: (w + 1) * span]
+            self._chunks.append(chunk)
+            self._delivered.append(0)
             if chunk.shape[0] > 0:
                 tq.put((_CMD_SAMPLE_EPOCH, chunk))
+
+    def iter_messages(self):
+        """Yield every message of the current epoch, surviving mid-epoch
+        worker death.
+
+        The reference's known gap (SURVEY §5): a dead mp worker's batches
+        never arrive and the trainer blocks forever on channel recv.  Here
+        recv has a heartbeat timeout; on timeout, dead workers are found,
+        the channel is drained of their in-flight batches (the shm ring
+        outlives the producer process, so nothing sent is lost), and each
+        dead worker is respawned with its undelivered batch-aligned seed
+        remainder.  Every batch of the epoch is yielded exactly once.
+        """
+        total = self.num_expected()
+        got = 0
+        fruitless_respawns = 0
+        while got < total:
+            msg = self.channel.recv(timeout=self.options.heartbeat_secs)
+            if msg is not None:
+                self._account(msg)
+                got += 1
+                fruitless_respawns = 0
+                yield msg
+                continue
+            dead = [w for w, p in enumerate(self._workers)
+                    if not p.is_alive()]
+            if not dead:
+                continue  # slow batch, keep waiting
+            # Drain in-flight messages before computing remainders: a batch
+            # already in the ring must not be reissued.
+            while True:
+                m = self.channel.recv(timeout=0)
+                if m is None:
+                    break
+                self._account(m)
+                got += 1
+                yield m
+            # Deterministic failures (bad builder, import error) would
+            # otherwise respawn forever; give up once respawns stop
+            # yielding any progress.
+            fruitless_respawns += 1
+            if fruitless_respawns > self.max_respawns:
+                raise RuntimeError(
+                    f"sampling workers died {fruitless_respawns} times "
+                    f"without delivering a batch; giving up (check the "
+                    f"dataset_builder runs in a spawned subprocess)")
+            for w in dead:
+                rest = self._chunks[w][
+                    self._delivered[w] * self.batch_size:]
+                self._respawn(w)
+                self._chunks[w] = rest
+                self._delivered[w] = 0
+                if rest.shape[0] > 0:
+                    self._task_queues[w].put((_CMD_SAMPLE_EPOCH, rest))
+
+    def _account(self, msg) -> None:
+        tag = msg.pop(_WORKER_KEY, None)
+        if tag is not None:
+            self._delivered[int(np.asarray(tag).ravel()[0])] += 1
 
     def shutdown(self) -> None:
         for tq in self._task_queues:

@@ -66,6 +66,7 @@ class _Producer:
                                      shuffle=True, seed=seed)
         self.buffer: "queue.Queue" = queue.Queue(maxsize=buffer_capacity)
         self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
 
     def num_expected(self) -> int:
         return len(self.loader)
@@ -78,6 +79,7 @@ class _Producer:
             self._thread.join(timeout=60)
             if self._thread.is_alive():
                 raise RuntimeError("previous epoch still producing")
+        self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -85,10 +87,26 @@ class _Producer:
         from .sample_message import batch_to_message
 
         for batch in self.loader:
-            self.buffer.put(serialize(batch_to_message(batch)))
+            payload = serialize(batch_to_message(batch))
+            # put with a stop check so a producer whose client vanished
+            # mid-epoch can exit instead of wedging on the bounded buffer
+            # (and permanently poisoning this producer id).
+            while not self._stop.is_set():
+                try:
+                    self.buffer.put(payload, timeout=0.5)
+                    break
+                except queue.Full:
+                    continue
+            if self._stop.is_set():
+                return
 
     def fetch(self) -> bytes:
         return self.buffer.get()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
 
 
 class DistServer:
@@ -132,7 +150,9 @@ class DistServer:
             return {"ok": True}
         if op == "destroy_sampling_producer":
             with self._lock:
-                self._producers.pop(req["producer_id"], None)
+                prod = self._producers.pop(req["producer_id"], None)
+            if prod is not None:
+                prod.stop()
             return {"ok": True}
         if op == "exit":
             self._stop.set()

@@ -23,13 +23,14 @@ _MIX = np.uint64(0x9E3779B97F4A7C15)
 
 def hash_partition(ids: np.ndarray, num_parts: int, seed: int) -> np.ndarray:
     """Stateless balanced-ish owner assignment (splitmix-style mixer)."""
-    x = ids.astype(np.uint64) + np.uint64(seed) * _MIX
-    x ^= x >> np.uint64(30)
-    x *= np.uint64(0xBF58476D1CE4E5B9)
-    x ^= x >> np.uint64(27)
-    x *= np.uint64(0x94D049BB133111EB)
-    x ^= x >> np.uint64(31)
-    return (x % np.uint64(num_parts)).astype(np.int32)
+    with np.errstate(over="ignore"):  # uint64 wraparound is the point
+        x = ids.astype(np.uint64) + np.uint64(seed) * _MIX
+        x ^= x >> np.uint64(30)
+        x *= np.uint64(0xBF58476D1CE4E5B9)
+        x ^= x >> np.uint64(27)
+        x *= np.uint64(0x94D049BB133111EB)
+        x ^= x >> np.uint64(31)
+        return (x % np.uint64(num_parts)).astype(np.int32)
 
 
 class DistRandomPartitioner:
@@ -94,7 +95,9 @@ class DistRandomPartitioner:
         ranks = sorted(
             int(d[len("_spill_rank"):]) for d in os.listdir(self.output_dir)
             if d.startswith("_spill_rank"))
-        edge_pb = np.zeros(self.num_edges, np.int32)
+        # -1 marks "no rank spilled this edge" so coverage gaps fail loudly
+        # instead of silently landing every missing edge in partition 0.
+        edge_pb = np.full(self.num_edges, -1, np.int32)
         for p in range(self.num_parts):
             rows, cols, eids, ids, feats = [], [], [], [], []
             for r in ranks:
@@ -130,6 +133,12 @@ class DistRandomPartitioner:
                 np.save(os.path.join(fdir, "cache_feats.npy"),
                         np.empty((0,) + feats[0].shape[1:],
                                  feats[0].dtype))
+        unassigned = int(np.count_nonzero(edge_pb < 0))
+        if unassigned:
+            raise RuntimeError(
+                f"{unassigned} of {self.num_edges} edge ids were not "
+                f"covered by any rank's spill files; every rank must call "
+                f"partition_rank_chunk before finalize")
         np.save(os.path.join(self.output_dir, "edge_pb.npy"), edge_pb)
         np.save(os.path.join(self.output_dir, "node_feat_pb.npy"), node_pb)
         with open(os.path.join(self.output_dir, "META.json"), "w") as fh:

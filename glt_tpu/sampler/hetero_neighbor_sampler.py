@@ -277,6 +277,10 @@ class HeteroNeighborSampler(BaseSampler):
                                   PADDING_ID)
             out.metadata["edge_label"] = jnp.concatenate(
                 [pos_label, jnp.zeros((q * amount,), jnp.int32)])
+        elif mode is None and inputs.label is not None:
+            label = jnp.asarray(_pad_ids(inputs.label, q))
+            out.metadata["edge_label"] = jnp.where(
+                jnp.asarray(src) >= 0, label, PADDING_ID)
         return out
 
     def _get_edges_jit(self, et, mode, amount):

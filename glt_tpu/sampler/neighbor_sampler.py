@@ -259,6 +259,13 @@ class NeighborSampler(BaseSampler):
             neg_label = jnp.zeros((q * amount,), jnp.int32)
             meta["edge_label"] = jnp.concatenate([pos_label, neg_label])
             out.metadata = meta
+        elif mode is None and label is not None:
+            # Pass the caller's labels through unchanged (reference homo
+            # None branch: edge_label untouched, no +1 increment).
+            meta = out.metadata or {}
+            meta["edge_label"] = jnp.where(jnp.asarray(src) >= 0, label,
+                                           PADDING_ID)
+            out.metadata = meta
         out.metadata = out.metadata or {}
         out.metadata["num_pos"] = jnp.asarray(num_pos, jnp.int32)
         return out
@@ -321,6 +328,14 @@ class NeighborSampler(BaseSampler):
             meta["dst_pos_index"] = relabel_by_reference(out.node, dst)
             meta["dst_neg_index"] = relabel_by_reference(
                 out.node, neg_dst).reshape(q, amount)
+        else:
+            # No negative sampling still emits edge_label_index so the
+            # LinkLoader can locate seed edges in the batch
+            # (neighbor_sampler.py:366-372, the None-or-binary branch).
+            meta["edge_label_index"] = jnp.stack([
+                relabel_by_reference(out.node, src),
+                relabel_by_reference(out.node, dst),
+            ])
         out.metadata = meta
         return out
 

@@ -1,5 +1,8 @@
 """Worker-mode DistNeighborLoader tests (cf. test_dist_neighbor_loader.py):
 real subprocesses, real shm channel, id-determined verification."""
+import os
+import signal
+
 import numpy as np
 import pytest
 
@@ -63,6 +66,37 @@ def test_collocated_mode():
         check_batch(batch)
         seen.extend(np.asarray(batch.node)[:batch.batch_size].tolist())
     assert sorted(seen) == list(range(N))
+
+
+def test_mp_worker_death_mid_epoch():
+    """A SIGKILLed sampling worker must not lose batches or hang the epoch
+    (the reference's known failure mode, SURVEY §5): the producer reissues
+    the dead worker's undelivered seed range to a respawned worker."""
+    n = 60
+    loader = DistNeighborLoader(
+        [2, 2], np.arange(n), batch_size=6,
+        dataset_builder=build_ring_dataset, builder_args=(n,),
+        worker_options=MpSamplingWorkerOptions(
+            num_workers=2,
+            # Tiny ring keeps workers blocked on enqueue mid-epoch, so the
+            # kill always lands with seeds still outstanding.
+            channel_capacity_bytes=8192,
+            heartbeat_secs=0.5))
+    try:
+        it = iter(loader)
+        seen = []
+
+        def collect(b):
+            check_batch(b, n)
+            seen.extend(np.asarray(b.batch)[:b.batch_size].tolist())
+
+        collect(next(it))
+        os.kill(loader._producer._workers[0].pid, signal.SIGKILL)
+        for batch in it:
+            collect(batch)
+        assert sorted(seen) == list(range(n))
+    finally:
+        loader.shutdown()
 
 
 def test_mp_worker_mode():

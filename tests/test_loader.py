@@ -94,6 +94,31 @@ class TestLinkNeighborLoader:
                 assert lab[i] == 1
         assert n_batches == 3
 
+    def test_no_negative_sampling_emits_edge_label_index(self):
+        """neg_sampling=None still locates seed edges in the batch
+        (reference neighbor_sampler.py:366-372 None-or-binary branch)."""
+        ds = make_dataset()
+        src = np.arange(0, 8)
+        dst = (src + 1) % 24
+        labels = np.arange(8, dtype=np.int32) % 2
+        loader = LinkNeighborLoader(
+            ds, [2], np.stack([src, dst]), batch_size=4,
+            edge_label=labels)
+        n_batches = 0
+        for batch in loader:
+            eli = np.asarray(batch.metadata["edge_label_index"])
+            lab = np.asarray(batch.metadata["edge_label"])
+            nodes = np.asarray(batch.node)
+            assert eli.shape == (2, 4)
+            for i in range(4):
+                s, d = nodes[eli[0, i]], nodes[eli[1, i]]
+                assert (d - s) % 24 == 1
+            # labels pass through unchanged (no +1 increment)
+            start = n_batches * 4
+            np.testing.assert_array_equal(lab, labels[start: start + 4])
+            n_batches += 1
+        assert n_batches == 2
+
 
 class TestSubGraphLoader:
     def test_induced_batches(self):
