@@ -92,15 +92,21 @@ int q_lock(Header* h) {
   return rc;
 }
 
-int q_timedwait(pthread_cond_t* cv, Header* h, int timeout_ms) {
-  if (timeout_ms < 0) {
-    int rc = pthread_cond_wait(cv, &h->mu);
-    if (rc == EOWNERDEAD) {
-      pthread_mutex_consistent(&h->mu);
-      rc = 0;
-    }
-    return rc;
+// Absolute-deadline wait (deadline computed ONCE by the caller, so a
+// consumer repeatedly woken and beaten to the message by another consumer
+// still times out on schedule).  deadline == nullptr waits forever.
+int q_deadline_wait(pthread_cond_t* cv, Header* h,
+                    const struct timespec* deadline) {
+  int rc = deadline ? pthread_cond_timedwait(cv, &h->mu, deadline)
+                    : pthread_cond_wait(cv, &h->mu);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mu);
+    rc = 0;
   }
+  return rc;
+}
+
+struct timespec deadline_in_ms(int timeout_ms) {
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
   ts.tv_sec += timeout_ms / 1000;
@@ -109,12 +115,7 @@ int q_timedwait(pthread_cond_t* cv, Header* h, int timeout_ms) {
     ts.tv_sec += 1;
     ts.tv_nsec -= 1000000000L;
   }
-  int rc = pthread_cond_timedwait(cv, &h->mu, &ts);
-  if (rc == EOWNERDEAD) {
-    pthread_mutex_consistent(&h->mu);
-    rc = 0;
-  }
-  return rc;
+  return ts;
 }
 
 }  // namespace
@@ -198,7 +199,7 @@ int glt_shmq_enqueue(void* qp, const void* data, uint64_t size) {
   if (need > h->capacity) return -1;
   q_lock(h);
   while (h->capacity - (h->tail - h->head) < need) {
-    q_timedwait(&h->not_full, h, -1);
+    q_deadline_wait(&h->not_full, h, nullptr);
   }
   ring_write(q, h->tail, &size, sizeof(uint64_t));
   ring_write(q, h->tail + sizeof(uint64_t), data, size);
@@ -214,7 +215,7 @@ uint64_t glt_shmq_next_size(void* qp) {
   Header* h = q->hdr;
   q_lock(h);
   while (h->head == h->tail) {
-    q_timedwait(&h->not_empty, h, -1);
+    q_deadline_wait(&h->not_empty, h, nullptr);
   }
   uint64_t size;
   ring_read(q, h->head, &size, sizeof(uint64_t));
@@ -229,7 +230,7 @@ int64_t glt_shmq_dequeue(void* qp, void* out, uint64_t out_cap) {
   Header* h = q->hdr;
   q_lock(h);
   while (h->head == h->tail) {
-    q_timedwait(&h->not_empty, h, -1);
+    q_deadline_wait(&h->not_empty, h, nullptr);
   }
   uint64_t size;
   ring_read(q, h->head, &size, sizeof(uint64_t));
@@ -272,9 +273,16 @@ int glt_shmq_dequeue_alloc(void* qp, uint8_t** out, uint64_t* out_size,
                            int timeout_ms) {
   Queue* q = static_cast<Queue*>(qp);
   Header* h = q->hdr;
+  // Deadline fixed BEFORE the wait loop: a consumer woken by an enqueue
+  // but beaten to the message by another consumer must not restart its
+  // full timeout, or steady message traffic starves the timeout forever.
+  struct timespec deadline;
+  bool has_deadline = timeout_ms >= 0;
+  if (has_deadline) deadline = deadline_in_ms(timeout_ms);
   q_lock(h);
   while (h->head == h->tail) {
-    int rc = q_timedwait(&h->not_empty, h, timeout_ms);
+    int rc = q_deadline_wait(&h->not_empty, h,
+                             has_deadline ? &deadline : nullptr);
     if (rc == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return 1;

@@ -2,11 +2,13 @@ from .dist_options import (
     CollocatedSamplingWorkerOptions,
     MpSamplingWorkerOptions,
 )
+from .dist_dataset import DistDataset
 from .dist_loader import DistNeighborLoader
 from .sample_message import batch_to_message, message_to_batch
 
 __all__ = [
     "CollocatedSamplingWorkerOptions",
+    "DistDataset",
     "DistNeighborLoader",
     "MpSamplingWorkerOptions",
     "batch_to_message",

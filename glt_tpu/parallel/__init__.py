@@ -4,9 +4,20 @@ from .dist_sampler import (
     dist_sample_multi_hop,
     exchange_one_hop,
 )
-from .dist_feature import exchange_gather
+from .dist_feature import (
+    TieredShardedFeature,
+    cold_gather_host,
+    exchange_gather,
+    exchange_gather_hot,
+    shard_feature_tiered,
+)
 from .dist_hetero_sampler import DistHeteroNeighborSampler, shard_hetero_graph
-from .dist_train import init_dist_state, make_dist_train_step
+from .dist_train import (
+    TieredTrainPipeline,
+    init_dist_state,
+    make_dist_train_step,
+    make_tiered_train_step,
+)
 
 __all__ = [
     "DistHeteroNeighborSampler",
@@ -14,11 +25,17 @@ __all__ = [
     "shard_hetero_graph",
     "ShardedFeature",
     "ShardedGraph",
+    "TieredShardedFeature",
+    "TieredTrainPipeline",
+    "cold_gather_host",
     "dist_sample_multi_hop",
     "exchange_gather",
+    "exchange_gather_hot",
     "exchange_one_hop",
     "init_dist_state",
     "make_dist_train_step",
+    "make_tiered_train_step",
     "shard_feature",
+    "shard_feature_tiered",
     "shard_graph",
 ]

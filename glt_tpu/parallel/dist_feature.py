@@ -8,8 +8,24 @@ buffer.  Here the whole lookup is one collective round-trip: bucket ids by
 owner shard, ``all_to_all`` the id buckets, every shard gathers its rows
 from HBM, ``all_to_all`` the row blocks back, unscatter.  Payload rides ICI
 and overlaps with neighboring compute under XLA's scheduler.
+
+**Host tiering** (:class:`TieredShardedFeature`): when the feature matrix
+exceeds mesh HBM (papers100M ≈ 200GB), each shard keeps only a hotness-
+ordered prefix of its rows in HBM; the remainder stays in host DRAM.  The
+reference reads its host tier through UVA from inside the gather kernel
+(unified_tensor.cu:202-311); a TPU kernel cannot read host memory, so the
+cold path is a **host-side pipeline stage**: the sampler's node list (known
+after the sample stage) drives a numpy gather whose result is
+``device_put`` while the previous batch trains — the
+:class:`~glt_tpu.parallel.dist_train.TieredTrainPipeline` double-buffers
+the two jitted stages so step time approaches
+``max(device compute, host gather)``, the same overlap UVA bought the GPU.
 """
 from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -53,3 +69,127 @@ def exchange_gather(
         tiled=False).reshape(num_shards * b, d)
     out = resp[jnp.clip(routing.slot, 0, num_shards * b - 1)]
     return jnp.where(routing.valid[:, None], out, 0)
+
+
+class TieredShardedFeature(NamedTuple):
+    """Per-shard features split between HBM and host DRAM.
+
+    ``hot``: ``[S, hot_per_shard, d]`` device array (shard axis placed on
+    the mesh by ``put_sharded``); ``cold``: ``[S, c - hot_per_shard, d]``
+    host numpy.  Row ``r`` of shard ``s`` holds global (relabeled) id
+    ``s * c + r`` — use hotness-ordered
+    :func:`~glt_tpu.partition.contiguous.contiguous_relabel` so the prefix
+    really is the hot set (the ``cat_feature_cache``/``sort_by_in_degree``
+    role, reference data/reorder.py:18, partition/base.py:606).
+    """
+    hot: jnp.ndarray
+    cold: np.ndarray
+    nodes_per_shard: int
+    hot_per_shard: int
+    num_shards: int
+
+    @property
+    def dim(self) -> int:
+        return self.hot.shape[-1]
+
+
+def shard_feature_tiered(feature: np.ndarray, num_shards: int,
+                         hot_ratio: float, dtype=None
+                         ) -> TieredShardedFeature:
+    """Split ``[N, d]`` rows into per-shard HBM prefix + host remainder."""
+    feature = np.asarray(feature)
+    n, d = feature.shape
+    c = -(-n // num_shards)
+    h = int(round(c * float(hot_ratio)))
+    hot = np.zeros((num_shards, h, d), feature.dtype)
+    cold = np.zeros((num_shards, c - h, d), feature.dtype)
+    for s in range(num_shards):
+        lo, hi = min(s * c, n), min((s + 1) * c, n)
+        blk = feature[lo:hi]
+        hot[s, : min(h, hi - lo)] = blk[:h]
+        if hi - lo > h:
+            cold[s, : hi - lo - h] = blk[h:]
+    arr = jnp.asarray(hot) if dtype is None else jnp.asarray(hot, dtype)
+    return TieredShardedFeature(hot=arr, cold=cold, nodes_per_shard=c,
+                                hot_per_shard=h, num_shards=num_shards)
+
+
+def exchange_gather_hot(
+    ids: jnp.ndarray,
+    hot_rows: jnp.ndarray,
+    nodes_per_shard: int,
+    hot_per_shard: int,
+    num_shards: int,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Hot-tier half of a tiered gather; call inside ``shard_map``.
+
+    Same collective round-trip as :func:`exchange_gather`, but the serving
+    shard only answers requests whose local row sits inside its HBM prefix
+    (``local < hot_per_shard``); cold rows come back as zeros and are
+    filled in by the staged host gather (:func:`cold_gather_host`) via
+    :func:`merge_cold`.
+    """
+    b = ids.shape[0]
+    d = hot_rows.shape[-1]
+    owner = jnp.where(ids >= 0, ids // nodes_per_shard, -1)
+    routing = _bucket_by_owner(ids, owner, num_shards, cap=b)
+
+    requests = lax.all_to_all(
+        routing.buckets.reshape(num_shards, b), axis_name, 0, 0,
+        tiled=False).reshape(num_shards * b)
+
+    my_rank = lax.axis_index(axis_name)
+    local = requests - my_rank * nodes_per_shard
+    ok = (local >= 0) & (local < hot_per_shard) & (requests >= 0)
+    got = jnp.take(hot_rows, jnp.where(ok, local, 0), axis=0, mode="clip")
+    got = jnp.where(ok[:, None], got, 0)
+
+    resp = lax.all_to_all(
+        got.reshape(num_shards, b, d), axis_name, 0, 0,
+        tiled=False).reshape(num_shards * b, d)
+    out = resp[jnp.clip(routing.slot, 0, num_shards * b - 1)]
+    return jnp.where(routing.valid[:, None], out, 0)
+
+
+def cold_mask(ids: jnp.ndarray, nodes_per_shard: int,
+              hot_per_shard: int) -> jnp.ndarray:
+    """True where ``ids`` resolve to the host tier (jit-safe)."""
+    return (ids >= 0) & (ids % nodes_per_shard >= hot_per_shard)
+
+
+def merge_cold(hot_x: jnp.ndarray, staged_cold: jnp.ndarray,
+               ids: jnp.ndarray, nodes_per_shard: int,
+               hot_per_shard: int) -> jnp.ndarray:
+    """Overlay staged cold rows onto the hot-tier gather result."""
+    m = cold_mask(ids, nodes_per_shard, hot_per_shard)
+    return jnp.where(m[:, None], staged_cold.astype(hot_x.dtype), hot_x)
+
+
+def cold_gather_host(f: TieredShardedFeature,
+                     nodes: np.ndarray) -> np.ndarray:
+    """Host-side gather of the cold rows for per-shard node lists.
+
+    Args:
+      nodes: ``[S, cap]`` global (relabeled) ids, -1 padded — the sample
+        stage's ``out.node``.
+
+    Returns ``[S, cap, d]`` host array with zeros at hot/padding slots.
+    On a multi-host pod each host only holds its own shards' cold rows;
+    this single-process build holds all of them (the emulation mirrors the
+    reference's single-host multi-GPU tests, SURVEY §4).
+    """
+    nodes = np.asarray(nodes)
+    s_axis, cap = nodes.shape
+    c, h = f.nodes_per_shard, f.hot_per_shard
+    d = f.cold.shape[-1]
+    out = np.zeros((s_axis, cap, d), f.cold.dtype)
+    if f.cold.shape[1] == 0:
+        return out
+    flat = nodes.reshape(-1)
+    is_cold = (flat >= 0) & (flat % c >= h)
+    # Gather only the cold slots (typically a minority of the batch):
+    # the host stage bounds pipelined step time, so no wasted rows.
+    cold_flat = flat[is_cold]
+    out.reshape(-1, d)[is_cold] = f.cold[cold_flat // c, cold_flat % c - h]
+    return out

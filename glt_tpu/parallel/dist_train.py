@@ -18,14 +18,21 @@ from typing import Any, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.train import TrainState, seed_cross_entropy
 from ..typing import PADDING_ID
-from .dist_feature import exchange_gather
-from .dist_sampler import dist_sample_multi_hop
+from .dist_feature import (
+    TieredShardedFeature,
+    cold_gather_host,
+    exchange_gather,
+    exchange_gather_hot,
+    merge_cold,
+)
+from .dist_sampler import DistNeighborSampler, dist_sample_multi_hop
 from .sharding import ShardedFeature, ShardedGraph
 
 
@@ -94,17 +101,160 @@ def make_dist_train_step(
     return step
 
 
-def init_dist_state(model, tx, g: ShardedGraph, f: ShardedFeature,
+def make_tiered_train_step(
+    model,
+    tx,
+    g: ShardedGraph,
+    f: TieredShardedFeature,
+    labels: jnp.ndarray,          # [S, nodes_per_shard] int labels
+    mesh: Mesh,
+    batch_size: int,
+    axis_name: str = "shard",
+):
+    """Build the train half of the tiered two-stage pipeline.
+
+    Returns ``train(state, out, cold_x, key) -> (state, loss, acc)`` where
+    ``out`` is the sample stage's per-shard :class:`SamplerOutput` and
+    ``cold_x`` is the host-gathered ``[S, node_cap, d]`` cold-row block
+    (:func:`~glt_tpu.parallel.dist_feature.cold_gather_host`).  Hot rows
+    ride the in-jit all-to-all; cold rows are overlaid where
+    ``node % c >= hot_per_shard`` — the split the reference's UnifiedTensor
+    makes per-row inside its gather kernel (unified_tensor.cu:48-81).
+    """
+    gspec = P(axis_name)
+
+    def local_body(hot_rows, labels_blk, out, cold_x, params, key):
+        hot_rows, labels_blk, cold_x = hot_rows[0], labels_blk[0], cold_x[0]
+        out = jax.tree.map(lambda x: x[0], out)
+        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+
+        hot_x = exchange_gather_hot(out.node, hot_rows, f.nodes_per_shard,
+                                    f.hot_per_shard, f.num_shards, axis_name)
+        x = merge_cold(hot_x, cold_x, out.node, f.nodes_per_shard,
+                       f.hot_per_shard)
+        y = exchange_gather(out.node, labels_blk[:, None].astype(jnp.int32),
+                            g.nodes_per_shard, g.num_shards, axis_name)[:, 0]
+        y = jnp.where(out.node >= 0, y, PADDING_ID)
+        edge_index = jnp.stack([out.row, out.col])
+
+        def loss_fn(p):
+            logits = model.apply(p, x, edge_index, out.edge_mask,
+                                 train=True, rngs={"dropout": key})
+            return seed_cross_entropy(logits, y, batch_size, out.node_mask)
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params)
+        grads = lax.pmean(grads, axis_name)
+        loss = lax.pmean(loss, axis_name)
+        acc = lax.pmean(acc, axis_name)
+        return loss, acc, grads
+
+    shard_fn = jax.shard_map(
+        local_body, mesh=mesh,
+        in_specs=(gspec, gspec, gspec, gspec, P(), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False)
+
+    @jax.jit
+    def train(state: TrainState, out, cold_x, key: jax.Array):
+        loss, acc, grads = shard_fn(f.hot, labels, out, cold_x,
+                                    state.params, key)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss, acc
+
+    return train
+
+
+class TieredTrainPipeline:
+    """Two-stage software pipeline: jitted sample → host cold gather →
+    jitted train, double-buffered.
+
+    The cold gather for batch ``k`` runs on a staging thread while the main
+    thread trains batch ``k-1`` — steady-state step time ≈
+    ``max(device compute, host cold gather)`` rather than their sum, the
+    UVA-overlap property of the reference's UnifiedTensor
+    (unified_tensor.cu:202-311) recovered at the pipeline level.  A thread
+    (not jax async dispatch) carries the overlap so it holds on every
+    backend, including the synchronous CPU emulation the tests run on.
+    """
+
+    def __init__(self, sampler: DistNeighborSampler,
+                 train_step, f: TieredShardedFeature, mesh: Mesh,
+                 axis_name: str = "shard"):
+        import concurrent.futures
+
+        self.sampler = sampler
+        self.train_step = train_step
+        self.f = f
+        self._cold_spec = jax.sharding.NamedSharding(mesh, P(axis_name))
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="glt-cold-stage")
+
+    def _stage_cold_async(self, out):
+        """Submit the host gather for ``out.node``; returns a future."""
+        def work():
+            nodes = np.asarray(out.node)   # waits on the sample stage only
+            cold = cold_gather_host(self.f, nodes)
+            return jax.device_put(cold, self._cold_spec)
+        return self._pool.submit(work)
+
+    def run_epoch(self, state: TrainState, seed_batches, key: jax.Array):
+        """Drive one epoch; ``seed_batches``: iterable of ``[S, B]`` seeds.
+
+        Returns ``(state, losses, accs)`` (device scalars, unsynced).
+        """
+        losses, accs = [], []
+        pending = None  # (out, cold future)
+        n = 0
+        for i, seeds in enumerate(seed_batches):
+            kb = jax.random.fold_in(key, i)
+            out = self.sampler.sample_from_nodes(jnp.asarray(seeds),
+                                                 key=jax.random.fold_in(kb, 1))
+            fut = self._stage_cold_async(out)
+            if pending is not None:
+                state, loss, acc = self.train_step(
+                    state, pending[0], pending[1].result(),
+                    jax.random.fold_in(kb, 2))
+                losses.append(loss)
+                accs.append(acc)
+            pending = (out, fut)
+            n = i + 1
+        if pending is not None:
+            state, loss, acc = self.train_step(
+                state, pending[0], pending[1].result(),
+                jax.random.fold_in(jax.random.fold_in(key, n), 2))
+            losses.append(loss)
+            accs.append(acc)
+        return state, losses, accs
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def init_dist_state(model, tx, g: ShardedGraph, f,
                     rng: jax.Array, num_neighbors: Sequence[int],
-                    batch_size: int) -> TrainState:
-    """Initialize replicated params/opt-state with correctly-shaped dummies."""
+                    batch_size: int,
+                    frontier_cap: Optional[int] = None) -> TrainState:
+    """Initialize replicated params/opt-state with correctly-shaped dummies.
+
+    ``f`` may be a :class:`ShardedFeature` or
+    :class:`~glt_tpu.parallel.dist_feature.TieredShardedFeature`.
+    """
     from ..sampler.neighbor_sampler import hop_widths, max_sampled_nodes
 
-    cap = max_sampled_nodes(batch_size, list(num_neighbors))
-    widths = hop_widths(batch_size, list(num_neighbors))
+    cap = max_sampled_nodes(batch_size, list(num_neighbors), frontier_cap)
+    widths = hop_widths(batch_size, list(num_neighbors), frontier_cap)
     ecap = sum(w * fo for w, fo in zip(widths, num_neighbors))
 
-    x = jnp.zeros((cap, f.rows.shape[-1]), f.rows.dtype)
+    rows = f.hot if isinstance(f, TieredShardedFeature) else f.rows
+    x = jnp.zeros((cap, rows.shape[-1]), rows.dtype)
     ei = jnp.full((2, ecap), PADDING_ID, jnp.int32)
     mask = jnp.zeros((ecap,), bool)
     params = model.init({"params": rng}, x, ei, mask)

@@ -26,11 +26,27 @@ class ContiguousRelabel(NamedTuple):
     num_parts: int
 
 
-def contiguous_relabel(node_pb: np.ndarray) -> ContiguousRelabel:
-    """Build the relabeling for a dense node partition book."""
+def contiguous_relabel(node_pb: np.ndarray,
+                       hotness: Optional[np.ndarray] = None,
+                       num_parts: Optional[int] = None
+                       ) -> ContiguousRelabel:
+    """Build the relabeling for a dense node partition book.
+
+    ``hotness`` (optional, ``[N]``) orders each partition's nodes
+    hottest-first within its contiguous range, so a per-shard HBM prefix
+    (:class:`~glt_tpu.parallel.dist_feature.TieredShardedFeature`) covers
+    the most-accessed rows.  This is the static-shape translation of the
+    reference's ``cat_feature_cache`` (partition/base.py:606-647): with
+    fixed-shape all-to-all exchanges, replicating remote-hot rows locally
+    cannot reduce collective bytes, so hotness instead decides which rows
+    live in HBM vs host DRAM.
+    """
     node_pb = np.asarray(node_pb)
     n = node_pb.shape[0]
-    num_parts = int(node_pb.max()) + 1
+    if num_parts is None:
+        # Derived from the book when not given; pass it explicitly when
+        # trailing partitions may be empty.
+        num_parts = int(node_pb.max()) + 1
     counts = np.bincount(node_pb, minlength=num_parts)
     c = int(counts.max())
 
@@ -38,6 +54,9 @@ def contiguous_relabel(node_pb: np.ndarray) -> ContiguousRelabel:
     new2old = np.full(num_parts * c, -1, np.int64)
     for p in range(num_parts):
         own = np.where(node_pb == p)[0]
+        if hotness is not None:
+            own = own[np.argsort(-np.asarray(hotness)[own],
+                                 kind="stable")]
         old2new[own] = p * c + np.arange(own.shape[0])
         new2old[p * c: p * c + own.shape[0]] = own
     return ContiguousRelabel(old2new, new2old, c, num_parts)
