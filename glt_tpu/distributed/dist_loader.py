@@ -25,17 +25,22 @@ from .dist_sampling_producer import MpSamplingProducer
 from .sample_message import message_to_batch
 
 
-class DistNeighborLoader:
-    """Neighbor loader with selectable sampling-worker deployment.
+class _DistLoaderBase:
+    """Shared deployment-mode plumbing for the three concrete loaders
+    (cf. DistLoader, dist_loader.py:142-221; concrete loaders
+    dist_neighbor_loader.py:28, dist_link_neighbor_loader.py:31,
+    dist_subgraph_loader.py:28).
 
     Collocated mode needs a live ``dataset``; mp mode needs a picklable
     ``dataset_builder`` (workers rebuild the dataset host-side).
     """
 
+    _KIND = "node"
+
     def __init__(
         self,
         num_neighbors: Sequence[int],
-        input_nodes: np.ndarray,
+        input_seeds: np.ndarray,
         batch_size: int = 512,
         shuffle: bool = False,
         dataset=None,
@@ -43,29 +48,35 @@ class DistNeighborLoader:
         builder_args: tuple = (),
         worker_options=None,
         seed: int = 0,
+        **kind_kwargs,
     ):
         worker_options = worker_options or CollocatedSamplingWorkerOptions()
         self.options = worker_options
-        self._inner: Optional[NeighborLoader] = None
+        self._inner = None
         self._producer: Optional[MpSamplingProducer] = None
 
         if isinstance(worker_options, CollocatedSamplingWorkerOptions):
             if dataset is None:
                 raise ValueError("collocated mode requires dataset=")
-            self._inner = NeighborLoader(
-                dataset, num_neighbors, input_nodes, batch_size=batch_size,
-                shuffle=shuffle, seed=seed)
+            self._inner = self._make_inner(
+                dataset, num_neighbors, input_seeds, batch_size, shuffle,
+                seed, kind_kwargs)
         elif isinstance(worker_options, MpSamplingWorkerOptions):
             if dataset_builder is None:
                 raise ValueError("mp mode requires dataset_builder=")
             self.channel = ShmChannel(
                 capacity_bytes=worker_options.channel_capacity_bytes)
             self._producer = MpSamplingProducer(
-                dataset_builder, builder_args, num_neighbors, input_nodes,
-                batch_size, worker_options, self.channel, shuffle=shuffle)
+                dataset_builder, builder_args, num_neighbors, input_seeds,
+                batch_size, worker_options, self.channel, shuffle=shuffle,
+                kind=self._KIND, kind_kwargs=kind_kwargs or None)
             self._producer.init()
         else:
             raise TypeError(f"unknown worker options {worker_options!r}")
+
+    def _make_inner(self, dataset, num_neighbors, input_seeds, batch_size,
+                    shuffle, seed, kind_kwargs):
+        raise NotImplementedError
 
     def __iter__(self) -> Iterator[Batch]:
         if self._inner is not None:

@@ -31,8 +31,18 @@ _WORKER_KEY = "#worker"
 
 def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
                           num_neighbors, batch_size, channel, task_queue,
-                          seed):
-    """Subprocess body (cf. dist_sampling_producer.py:52)."""
+                          seed, kind="node", kind_kwargs=None):
+    """Subprocess body (cf. dist_sampling_producer.py:52).
+
+    ``kind`` selects the sampling task, mirroring the reference's three
+    concrete distributed loaders (dist_neighbor_loader.py:28,
+    dist_link_neighbor_loader.py:31, dist_subgraph_loader.py:28):
+      * 'node': chunk entries are seed node ids;
+      * 'link': chunk entries are seed-edge POSITIONS into
+        ``kind_kwargs['edge_label_index']``;
+      * 'subgraph': seed node ids, induced extraction with
+        ``kind_kwargs['max_degree']``.
+    """
     # The TPU chip belongs to the trainer; workers sample on host CPU.
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -40,15 +50,31 @@ def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
     jax.config.update("jax_platforms", "cpu")
 
     from ..loader.node_loader import NodeLoader
-    from ..sampler.base import NodeSamplerInput
+    from ..sampler.base import EdgeSamplerInput, NodeSamplerInput
     from ..sampler.neighbor_sampler import NeighborSampler
 
+    kk = kind_kwargs or {}
     data = dataset_builder(*builder_args)
     sampler = NeighborSampler(data.get_graph(), num_neighbors,
                               batch_size=batch_size,
                               seed=seed + worker_id)
     collate_loader = NodeLoader(data, sampler, np.empty(0, np.int64),
                                 batch_size=batch_size)
+
+    def sample(chunk_part):
+        if kind == "node":
+            return sampler.sample_from_nodes(NodeSamplerInput(chunk_part))
+        if kind == "link":
+            eli = kk["edge_label_index"]
+            lab = kk.get("edge_label")
+            return sampler.sample_from_edges(EdgeSamplerInput(
+                row=eli[0, chunk_part], col=eli[1, chunk_part],
+                label=None if lab is None else lab[chunk_part],
+                neg_sampling=kk.get("neg_sampling")))
+        if kind == "subgraph":
+            return sampler.subgraph(NodeSamplerInput(chunk_part),
+                                    max_degree=kk["max_degree"])
+        raise ValueError(f"unknown sampling kind {kind!r}")
 
     while True:
         cmd, payload = task_queue.get()
@@ -57,7 +83,7 @@ def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
         seeds_chunk = payload
         for lo in range(0, seeds_chunk.shape[0], batch_size):
             seeds = seeds_chunk[lo: lo + batch_size]
-            out = sampler.sample_from_nodes(NodeSamplerInput(seeds))
+            out = sample(seeds)
             batch = collate_loader._collate_fn(out, seeds.shape[0])
             msg = batch_to_message(batch)
             # Provenance tag so the trainer can attribute delivered batches
@@ -85,7 +111,11 @@ class MpSamplingProducer:
         options: MpSamplingWorkerOptions,
         channel: ShmChannel,
         shuffle: bool = False,
+        kind: str = "node",
+        kind_kwargs: Optional[dict] = None,
     ):
+        self.kind = kind
+        self.kind_kwargs = kind_kwargs
         self.input_nodes = np.asarray(input_nodes).astype(np.int64)
         self.batch_size = int(batch_size)
         self.options = options
@@ -106,7 +136,8 @@ class MpSamplingProducer:
         p = self._ctx.Process(
             target=_sampling_worker_loop,
             args=(w, builder, args, nn, self.batch_size, self.channel,
-                  tq, self.options.worker_seed),
+                  tq, self.options.worker_seed, self.kind,
+                  self.kind_kwargs),
             daemon=True)
         p.start()
         return p, tq

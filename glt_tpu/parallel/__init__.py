@@ -1,6 +1,7 @@
 from .sharding import ShardedGraph, ShardedFeature, shard_graph, shard_feature
 from .dist_sampler import (
     DistNeighborSampler,
+    dist_node_subgraph,
     dist_sample_multi_hop,
     exchange_one_hop,
 )

@@ -25,12 +25,13 @@ from typing import NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.neighbor_sample import sample_neighbors
-from ..ops.unique import unique_first_occurrence
-from ..sampler.base import SamplerOutput
+from ..ops.neighbor_sample import _row_offsets_and_degrees, sample_neighbors
+from ..ops.unique import relabel_by_reference, unique_first_occurrence
+from ..sampler.base import NegativeSampling, SamplerOutput
 from ..sampler.neighbor_sampler import hop_widths, max_sampled_nodes
 from ..typing import PADDING_ID
 
@@ -293,6 +294,75 @@ def dist_sample_multi_hop(
     )
 
 
+def dist_node_subgraph(
+    indptr: jnp.ndarray,
+    indices: jnp.ndarray,
+    edge_ids: jnp.ndarray,
+    nodes: jnp.ndarray,
+    max_degree: int,
+    nodes_per_shard: int,
+    num_shards: int,
+    axis_name: str,
+):
+    """Distributed induced-subgraph extraction; call inside ``shard_map``.
+
+    TPU rebuild of the reference's distributed subgraph path
+    (dist_neighbor_sampler.py:456-516): there, node-set rows are fetched
+    from owner workers over RPC and the CUDA SubGraphOp filters them.  Here
+    each node's CSR row (capped at ``max_degree``) comes back through one
+    all-to-all round trip, and membership filtering is the same sorted
+    lookup the single-device op uses (ops/subgraph.py).
+
+    Args:
+      nodes: ``[B]`` unique global node ids (-1 padded).
+
+    Returns ``(rows, cols, eids, mask)`` of shape ``[B * max_degree]`` —
+    local indices into ``nodes``, matching
+    :class:`~glt_tpu.ops.subgraph.SubGraphOutput`.
+    """
+    b = nodes.shape[0]
+    owner = jnp.where(nodes >= 0, nodes // nodes_per_shard, -1)
+    routing = _bucket_by_owner(nodes, owner, num_shards, cap=b)
+
+    requests = lax.all_to_all(
+        routing.buckets.reshape(num_shards, b), axis_name, 0, 0,
+        tiled=False).reshape(num_shards * b)
+
+    my_rank = lax.axis_index(axis_name)
+    local = jnp.where(requests >= 0,
+                      requests - my_rank * nodes_per_shard, -1)
+    local = jnp.where((local >= 0) & (local < nodes_per_shard), local, -1)
+    start, deg = _row_offsets_and_degrees(indptr, local.astype(jnp.int32))
+    start = start.astype(jnp.int32)
+    offs = jnp.arange(max_degree, dtype=jnp.int32)[None, :]
+    in_row = (offs < deg[:, None]) & (local >= 0)[:, None]
+    flat = start[:, None] + jnp.where(in_row, offs, 0)
+    nbrs = jnp.where(in_row, indices[flat], PADDING_ID).astype(jnp.int32)
+    eids = jnp.where(in_row, edge_ids[flat], PADDING_ID).astype(jnp.int32)
+
+    resp_nbrs = lax.all_to_all(
+        nbrs.reshape(num_shards, b, max_degree), axis_name, 0, 0,
+        tiled=False).reshape(num_shards * b, max_degree)
+    resp_eids = lax.all_to_all(
+        eids.reshape(num_shards, b, max_degree), axis_name, 0, 0,
+        tiled=False).reshape(num_shards * b, max_degree)
+    nbrs = jnp.where(routing.valid[:, None], resp_nbrs[routing.slot],
+                     PADDING_ID)
+    eids = jnp.where(routing.valid[:, None], resp_eids[routing.slot],
+                     PADDING_ID)
+
+    # Membership + relabel (ops/subgraph.py:56-63 semantics).
+    local_dst = relabel_by_reference(nodes, nbrs.ravel()).reshape(
+        b, max_degree)
+    keep = (nbrs >= 0) & (local_dst >= 0)
+    local_src = jnp.broadcast_to(
+        jnp.arange(b, dtype=jnp.int32)[:, None], (b, max_degree))
+    rows = jnp.where(keep, local_src, PADDING_ID).ravel()
+    cols = jnp.where(keep, local_dst, PADDING_ID).ravel()
+    eids = jnp.where(keep, eids, PADDING_ID).ravel()
+    return rows, cols, eids, keep.ravel()
+
+
 class DistNeighborSampler:
     """Multi-hop distributed sampler over a :class:`ShardedGraph`.
 
@@ -309,8 +379,12 @@ class DistNeighborSampler:
                  batch_size: int = 512,
                  frontier_cap: Optional[int] = None,
                  collective: str = "all_to_all",
+                 valid_per_shard: Optional[np.ndarray] = None,
                  seed: int = 0):
         self.collective = collective
+        self.valid_per_shard = valid_per_shard
+        self._edges_fns = {}
+        self._subgraph_fns = {}
         self.g = sharded_graph
         self.mesh = mesh
         self.axis_name = axis_name
@@ -360,3 +434,155 @@ class DistNeighborSampler:
         g = self.g
         return self._shard_fn(g.indptr, g.indices, g.edge_ids,
                               seeds_per_shard, key)
+
+    # -- distributed link path (cf. dist_neighbor_sampler.py:327-453) ------
+    def _valid_per_shard(self) -> jnp.ndarray:
+        """Valid-node count per shard, for uniform negative draws."""
+        if self.valid_per_shard is not None:
+            return jnp.asarray(self.valid_per_shard, jnp.int32)
+        g = self.g
+        counts = np.clip(g.num_nodes - np.arange(g.num_shards)
+                         * g.nodes_per_shard, 0, g.nodes_per_shard)
+        return jnp.asarray(counts, jnp.int32)
+
+    def sample_from_edges(self, src: jnp.ndarray, dst: jnp.ndarray,
+                          neg_sampling: Optional[NegativeSampling] = None,
+                          key: Optional[jax.Array] = None) -> SamplerOutput:
+        """Distributed seed-edge sampling with non-strict negatives.
+
+        ``src`` / ``dst``: ``[S, B]`` global endpoint ids per shard (-1
+        padded).  Negatives are uniform over valid node ids — the
+        reference's distributed engine is likewise non-strict
+        (dist_neighbor_sampler.py:327-453: "we use non-strict negative
+        sampling in distributed mode").  Returns a per-shard
+        :class:`SamplerOutput` whose metadata carries ``edge_label_index``
+        + ``edge_label`` (binary/None) or the triplet indices.
+        """
+        if key is None:
+            key = self._next_key()
+        mode = None if neg_sampling is None else neg_sampling.mode
+        amount = (0 if neg_sampling is None
+                  else int(round(neg_sampling.amount)))
+        fn = self._get_edges_fn(mode, amount, int(src.shape[1]))
+        g = self.g
+        return fn(g.indptr, g.indices, g.edge_ids, src, dst, key)
+
+    def _get_edges_fn(self, mode, amount, q):
+        k = (mode, amount, q)
+        if k not in self._edges_fns:
+            gspec = P(self.axis_name)
+
+            def local(indptr, indices, eids, src, dst, key):
+                out = self._edges_body(mode, amount, q, indptr[0],
+                                       indices[0], eids[0], src[0], dst[0],
+                                       key)
+                return jax.tree.map(lambda x: x[None], out)
+
+            self._edges_fns[k] = jax.jit(jax.shard_map(
+                local, mesh=self.mesh,
+                in_specs=(gspec, gspec, gspec, gspec, gspec, P()),
+                out_specs=gspec, check_vma=False))
+        return self._edges_fns[k]
+
+    def _edges_body(self, mode, amount, q, indptr, indices, eids, src, dst,
+                    key):
+        key = jax.random.fold_in(key, lax.axis_index(self.axis_name))
+        kneg, ksample = jax.random.split(key)
+        counts = self._valid_per_shard()
+        c = self.g.nodes_per_shard
+        s_count = self.g.num_shards
+
+        def uniform_ids(k, n):
+            """Uniform over valid (relabeled) ids: pick a shard, then a
+            row modulo that shard's valid count."""
+            ks, ku = jax.random.split(k)
+            sh = jax.random.randint(ks, (n,), 0, s_count, dtype=jnp.int32)
+            u = jax.random.randint(ku, (n,), 0, c, dtype=jnp.int32)
+            return sh * c + u % jnp.maximum(counts[sh], 1)
+
+        if mode == "binary":
+            ks, kd = jax.random.split(kneg)
+            neg_src = uniform_ids(ks, q * amount)
+            neg_dst = uniform_ids(kd, q * amount)
+            rep = jnp.repeat(src >= 0, amount)
+            neg_src = jnp.where(rep, neg_src, PADDING_ID)
+            neg_dst = jnp.where(rep, neg_dst, PADDING_ID)
+            seeds = jnp.concatenate([src, dst, neg_src, neg_dst])
+        elif mode == "triplet":
+            neg_dst = uniform_ids(kneg, q * amount)
+            neg_dst = jnp.where(jnp.repeat(src >= 0, amount), neg_dst,
+                                PADDING_ID)
+            seeds = jnp.concatenate([src, dst, neg_dst])
+        else:
+            seeds = jnp.concatenate([src, dst])
+
+        out = dist_sample_multi_hop(
+            indptr, indices, eids, seeds, ksample, self.num_neighbors,
+            c, s_count, self.axis_name, self.frontier_cap, self.collective)
+
+        meta = {}
+        if mode == "binary":
+            all_src = jnp.concatenate([src, neg_src])
+            all_dst = jnp.concatenate([dst, neg_dst])
+            meta["edge_label_index"] = jnp.stack([
+                relabel_by_reference(out.node, all_src),
+                relabel_by_reference(out.node, all_dst)])
+            pos_label = jnp.where(src >= 0, 1, PADDING_ID)
+            meta["edge_label"] = jnp.concatenate(
+                [pos_label, jnp.zeros((q * amount,), jnp.int32)])
+        elif mode == "triplet":
+            meta["src_index"] = relabel_by_reference(out.node, src)
+            meta["dst_pos_index"] = relabel_by_reference(out.node, dst)
+            meta["dst_neg_index"] = relabel_by_reference(
+                out.node, neg_dst).reshape(q, amount)
+        else:
+            meta["edge_label_index"] = jnp.stack([
+                relabel_by_reference(out.node, src),
+                relabel_by_reference(out.node, dst)])
+        out.metadata = meta
+        return out
+
+    # -- distributed subgraph (cf. dist_neighbor_sampler.py:456-516) -------
+    def subgraph(self, seeds_per_shard: jnp.ndarray, max_degree: int = 64,
+                 key: Optional[jax.Array] = None) -> SamplerOutput:
+        """Hop expansion + distributed induced-subgraph extraction.
+
+        Each shard's node set is collected by the multi-hop exchange, then
+        every member's (capped) adjacency row is fetched from its owner
+        shard and filtered to the set — all inside one jitted program.
+        """
+        if key is None:
+            key = self._next_key()
+        fn = self._get_subgraph_fn(int(max_degree))
+        g = self.g
+        return fn(g.indptr, g.indices, g.edge_ids, seeds_per_shard, key)
+
+    def _get_subgraph_fn(self, max_degree):
+        if max_degree not in self._subgraph_fns:
+            gspec = P(self.axis_name)
+
+            def local(indptr, indices, eids, seeds, key):
+                key = jax.random.fold_in(key, lax.axis_index(self.axis_name))
+                base = dist_sample_multi_hop(
+                    indptr[0], indices[0], eids[0], seeds[0], key,
+                    self.num_neighbors, self.g.nodes_per_shard,
+                    self.g.num_shards, self.axis_name, self.frontier_cap,
+                    self.collective)
+                rows, cols, se, mask = dist_node_subgraph(
+                    indptr[0], indices[0], eids[0], base.node, max_degree,
+                    self.g.nodes_per_shard, self.g.num_shards,
+                    self.axis_name)
+                out = SamplerOutput(
+                    node=base.node, row=rows, col=cols, edge=se,
+                    batch=seeds[0], node_mask=base.node_mask,
+                    edge_mask=mask,
+                    num_sampled_nodes=base.num_sampled_nodes,
+                    metadata={"mapping": jnp.arange(self.batch_size,
+                                                    dtype=jnp.int32)})
+                return jax.tree.map(lambda x: x[None], out)
+
+            self._subgraph_fns[max_degree] = jax.jit(jax.shard_map(
+                local, mesh=self.mesh,
+                in_specs=(gspec, gspec, gspec, gspec, P()),
+                out_specs=gspec, check_vma=False))
+        return self._subgraph_fns[max_degree]
