@@ -3,13 +3,19 @@ from .dist_options import (
     MpSamplingWorkerOptions,
 )
 from .dist_dataset import DistDataset
-from .dist_loader import DistNeighborLoader
+from .dist_loader import (
+    DistLinkNeighborLoader,
+    DistNeighborLoader,
+    DistSubGraphLoader,
+)
 from .sample_message import batch_to_message, message_to_batch
 
 __all__ = [
     "CollocatedSamplingWorkerOptions",
     "DistDataset",
+    "DistLinkNeighborLoader",
     "DistNeighborLoader",
+    "DistSubGraphLoader",
     "MpSamplingWorkerOptions",
     "batch_to_message",
     "message_to_batch",

@@ -21,7 +21,7 @@ from .dist_options import (
     CollocatedSamplingWorkerOptions,
     MpSamplingWorkerOptions,
 )
-from .dist_sampling_producer import MpSamplingProducer
+from .dist_sampling_producer import MpSamplingProducer, WORKER_SAMPLER_KWARGS
 from .sample_message import message_to_batch
 
 
@@ -36,6 +36,10 @@ class _DistLoaderBase:
     """
 
     _KIND = "node"
+    # When set, mp mode rejects kind_kwargs outside this set (workers would
+    # silently drop them); None means the subclass's explicit signature
+    # already bounds what reaches the workers.
+    _ALLOWED_MP_KWARGS: Optional[frozenset] = None
 
     def __init__(
         self,
@@ -64,6 +68,12 @@ class _DistLoaderBase:
         elif isinstance(worker_options, MpSamplingWorkerOptions):
             if dataset_builder is None:
                 raise ValueError("mp mode requires dataset_builder=")
+            if self._ALLOWED_MP_KWARGS is not None:
+                bad = set(kind_kwargs) - self._ALLOWED_MP_KWARGS
+                if bad:
+                    raise TypeError(
+                        f"mp sampling workers do not support {sorted(bad)}"
+                        f" (collocated mode only)")
             self.channel = ShmChannel(
                 capacity_bytes=worker_options.channel_capacity_bytes)
             self._producer = MpSamplingProducer(
@@ -71,6 +81,7 @@ class _DistLoaderBase:
                 batch_size, worker_options, self.channel, shuffle=shuffle,
                 kind=self._KIND, kind_kwargs=kind_kwargs or None)
             self._producer.init()
+            self._num_batches = self._producer.num_expected()
         else:
             raise TypeError(f"unknown worker options {worker_options!r}")
 
@@ -91,7 +102,7 @@ class _DistLoaderBase:
     def __len__(self) -> int:
         if self._inner is not None:
             return len(self._inner)
-        return self._producer.num_expected()
+        return self._num_batches
 
     def shutdown(self) -> None:
         if self._producer is not None:
@@ -104,3 +115,98 @@ class _DistLoaderBase:
             self.shutdown()
         except Exception:
             pass
+
+
+class DistNeighborLoader(_DistLoaderBase):
+    """Worker-mode neighbor loader (cf. dist_neighbor_loader.py:28).
+
+    ``input_seeds`` are global seed node ids; each delivered :class:`Batch`
+    is a fully-collated multi-hop sample (features/labels gathered
+    worker-side in mp mode, in-process in collocated mode).
+    """
+
+    _KIND = "node"
+    _ALLOWED_MP_KWARGS = WORKER_SAMPLER_KWARGS
+
+    def _make_inner(self, dataset, num_neighbors, input_seeds, batch_size,
+                    shuffle, seed, kind_kwargs):
+        return NeighborLoader(
+            dataset, num_neighbors, input_seeds, batch_size=batch_size,
+            shuffle=shuffle, seed=seed, **kind_kwargs)
+
+
+class DistLinkNeighborLoader(_DistLoaderBase):
+    """Worker-mode link loader (cf. dist_link_neighbor_loader.py:31).
+
+    Seed *edges* drive ``sample_from_edges``; the channel messages carry
+    ``edge_label_index`` / ``edge_label`` (binary) or triplet indices, the
+    same metadata the collocated :class:`LinkNeighborLoader` emits.
+    """
+
+    _KIND = "link"
+
+    def __init__(
+        self,
+        num_neighbors: Sequence[int],
+        edge_label_index: np.ndarray,
+        edge_label: Optional[np.ndarray] = None,
+        neg_sampling=None,
+        batch_size: int = 512,
+        shuffle: bool = False,
+        dataset=None,
+        dataset_builder: Optional[Callable] = None,
+        builder_args: tuple = (),
+        worker_options=None,
+        seed: int = 0,
+    ):
+        eli = np.asarray(edge_label_index).astype(np.int64)
+        lab = None if edge_label is None else np.asarray(edge_label)
+        super().__init__(
+            num_neighbors, np.arange(eli.shape[1], dtype=np.int64),
+            batch_size=batch_size, shuffle=shuffle, dataset=dataset,
+            dataset_builder=dataset_builder, builder_args=builder_args,
+            worker_options=worker_options, seed=seed,
+            edge_label_index=eli, edge_label=lab, neg_sampling=neg_sampling)
+
+    def _make_inner(self, dataset, num_neighbors, input_seeds, batch_size,
+                    shuffle, seed, kind_kwargs):
+        from ..loader.link_loader import LinkNeighborLoader
+
+        return LinkNeighborLoader(
+            dataset, num_neighbors, kind_kwargs["edge_label_index"],
+            edge_label=kind_kwargs.get("edge_label"),
+            neg_sampling=kind_kwargs.get("neg_sampling"),
+            batch_size=batch_size, shuffle=shuffle, seed=seed)
+
+
+class DistSubGraphLoader(_DistLoaderBase):
+    """Worker-mode induced-subgraph loader (cf. dist_subgraph_loader.py:28)."""
+
+    _KIND = "subgraph"
+
+    def __init__(
+        self,
+        num_neighbors: Sequence[int],
+        input_seeds: np.ndarray,
+        batch_size: int = 64,
+        max_degree: int = 64,
+        shuffle: bool = False,
+        dataset=None,
+        dataset_builder: Optional[Callable] = None,
+        builder_args: tuple = (),
+        worker_options=None,
+        seed: int = 0,
+    ):
+        super().__init__(
+            num_neighbors, input_seeds, batch_size=batch_size,
+            shuffle=shuffle, dataset=dataset,
+            dataset_builder=dataset_builder, builder_args=builder_args,
+            worker_options=worker_options, seed=seed, max_degree=max_degree)
+
+    def _make_inner(self, dataset, num_neighbors, input_seeds, batch_size,
+                    shuffle, seed, kind_kwargs):
+        from ..loader.subgraph_loader import SubGraphLoader
+
+        return SubGraphLoader(
+            dataset, num_neighbors, input_seeds, batch_size=batch_size,
+            max_degree=kind_kwargs["max_degree"], shuffle=shuffle, seed=seed)

@@ -28,6 +28,10 @@ _CMD_STOP = 1
 
 _WORKER_KEY = "#worker"
 
+# Sampler-construction kwargs the worker loop honors for the node kind;
+# dist_loader validates mp-mode kwargs against this same set.
+WORKER_SAMPLER_KWARGS = frozenset({"frontier_cap", "with_edge"})
+
 
 def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
                           num_neighbors, batch_size, channel, task_queue,
@@ -57,22 +61,31 @@ def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
     data = dataset_builder(*builder_args)
     sampler = NeighborSampler(data.get_graph(), num_neighbors,
                               batch_size=batch_size,
+                              frontier_cap=kk.get("frontier_cap"),
+                              with_edge=kk.get("with_edge", True),
                               seed=seed + worker_id)
     collate_loader = NodeLoader(data, sampler, np.empty(0, np.int64),
                                 batch_size=batch_size)
 
-    def sample(chunk_part):
-        if kind == "node":
-            return sampler.sample_from_nodes(NodeSamplerInput(chunk_part))
+    # Link chunks arrive as (edge_label_index[2, n], labels-or-None) slices
+    # shipped in the task payload; node/subgraph chunks are id arrays.
+    def chunk_len(payload):
         if kind == "link":
-            eli = kk["edge_label_index"]
-            lab = kk.get("edge_label")
+            return payload[0].shape[1]
+        return payload.shape[0]
+
+    def sample(payload, lo, hi):
+        if kind == "node":
+            return sampler.sample_from_nodes(
+                NodeSamplerInput(payload[lo:hi]))
+        if kind == "link":
+            eli_c, lab_c = payload
             return sampler.sample_from_edges(EdgeSamplerInput(
-                row=eli[0, chunk_part], col=eli[1, chunk_part],
-                label=None if lab is None else lab[chunk_part],
+                row=eli_c[0, lo:hi], col=eli_c[1, lo:hi],
+                label=None if lab_c is None else lab_c[lo:hi],
                 neg_sampling=kk.get("neg_sampling")))
         if kind == "subgraph":
-            return sampler.subgraph(NodeSamplerInput(chunk_part),
+            return sampler.subgraph(NodeSamplerInput(payload[lo:hi]),
                                     max_degree=kk["max_degree"])
         raise ValueError(f"unknown sampling kind {kind!r}")
 
@@ -80,11 +93,11 @@ def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
         cmd, payload = task_queue.get()
         if cmd == _CMD_STOP:
             break
-        seeds_chunk = payload
-        for lo in range(0, seeds_chunk.shape[0], batch_size):
-            seeds = seeds_chunk[lo: lo + batch_size]
-            out = sample(seeds)
-            batch = collate_loader._collate_fn(out, seeds.shape[0])
+        n = chunk_len(payload)
+        for lo in range(0, n, batch_size):
+            hi = min(lo + batch_size, n)
+            out = sample(payload, lo, hi)
+            batch = collate_loader._collate_fn(out, hi - lo)
             msg = batch_to_message(batch)
             # Provenance tag so the trainer can attribute delivered batches
             # per worker and reissue a dead worker's unfinished seed range.
@@ -115,7 +128,12 @@ class MpSamplingProducer:
         kind_kwargs: Optional[dict] = None,
     ):
         self.kind = kind
-        self.kind_kwargs = kind_kwargs
+        # The seed-edge arrays stay host-side in the producer; workers get
+        # per-chunk slices in their task payload (shipping the full array
+        # to every spawned worker would copy it num_workers times).
+        self.kind_kwargs = dict(kind_kwargs or {})
+        self._link_eli = self.kind_kwargs.pop("edge_label_index", None)
+        self._link_label = self.kind_kwargs.pop("edge_label", None)
         self.input_nodes = np.asarray(input_nodes).astype(np.int64)
         self.batch_size = int(batch_size)
         self.options = options
@@ -164,6 +182,16 @@ class MpSamplingProducer:
         n = self.input_nodes.shape[0]
         return (n + self.batch_size - 1) // self.batch_size
 
+    def _payload(self, chunk: np.ndarray):
+        """Task payload for a seed chunk: the ids themselves, or for the
+        link kind the sliced seed-edge endpoints/labels (``chunk`` holds
+        positions into the producer-held ``edge_label_index``)."""
+        if self.kind == "link":
+            lab = (None if self._link_label is None
+                   else self._link_label[chunk])
+            return (self._link_eli[:, chunk], lab)
+        return chunk
+
     def produce_all(self) -> None:
         """Kick one epoch: split seeds batch-aligned across workers
         (cf. dist_sampling_producer.py:229-247)."""
@@ -181,7 +209,7 @@ class MpSamplingProducer:
             self._chunks.append(chunk)
             self._delivered.append(0)
             if chunk.shape[0] > 0:
-                tq.put((_CMD_SAMPLE_EPOCH, chunk))
+                tq.put((_CMD_SAMPLE_EPOCH, self._payload(chunk)))
 
     def iter_messages(self):
         """Yield every message of the current epoch, surviving mid-epoch
@@ -235,7 +263,8 @@ class MpSamplingProducer:
                 self._chunks[w] = rest
                 self._delivered[w] = 0
                 if rest.shape[0] > 0:
-                    self._task_queues[w].put((_CMD_SAMPLE_EPOCH, rest))
+                    self._task_queues[w].put(
+                        (_CMD_SAMPLE_EPOCH, self._payload(rest)))
 
     def _account(self, msg) -> None:
         tag = msg.pop(_WORKER_KEY, None)

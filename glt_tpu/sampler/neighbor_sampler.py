@@ -376,7 +376,15 @@ class NeighborSampler(BaseSampler):
     # -- induced subgraph (cf. neighbor_sampler.py:409-433) ---------------
     def subgraph(self, inputs: NodeSamplerInput, max_degree: int = 64,
                  key: Optional[jax.Array] = None) -> SamplerOutput:
-        """Hop expansion + induced-subgraph extraction (SubGraphOp path)."""
+        """Hop expansion + induced-subgraph extraction (SubGraphOp path).
+
+        Unlike ``sample_from_nodes`` (whose ``row`` is the transposed
+        message-source side), the induced subgraph keeps **graph-direction
+        COO**: ``row`` = CSR source, ``col`` = destination, matching the
+        reference SubGraph op (csrc/cuda/subgraph_op.cu) and PyG's
+        ``subgraph()``. Subgraph models (SEAL/DGCNN) treat the extract as
+        a standalone graph, so the raw direction is preserved.
+        """
         base = self.sample_from_nodes(inputs, key=key)
         g = self.graph
         sub = node_subgraph(g.indptr, g.indices, base.node, max_degree,

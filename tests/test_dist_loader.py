@@ -115,3 +115,73 @@ def test_mp_worker_mode():
             assert sorted(seen) == list(range(N))
     finally:
         loader.shutdown()
+
+
+def test_mp_link_loader():
+    """Worker-mode link loader (cf. test_dist_link_loader.py): positive
+    seed edges resolve to true ring successors through the relabeling,
+    labels carry, negatives land in valid id space."""
+    from glt_tpu.distributed import DistLinkNeighborLoader
+    from glt_tpu.sampler.base import NegativeSampling
+
+    src = np.arange(N)
+    eli = np.stack([src, (src + 1) % N])
+    loader = DistLinkNeighborLoader(
+        [2, 2], eli, neg_sampling=NegativeSampling("binary", amount=1),
+        batch_size=6, dataset_builder=build_ring_dataset,
+        worker_options=MpSamplingWorkerOptions(num_workers=2))
+    try:
+        npos_total = 0
+        for batch in loader:
+            nodes = np.asarray(batch.node)
+            elx = np.asarray(batch.metadata["edge_label_index"])
+            lab = np.asarray(batch.metadata["edge_label"])
+            x = np.asarray(batch.x)
+            mask = np.asarray(batch.node_mask)
+            np.testing.assert_allclose(x[mask][:, 0], nodes[mask])
+            gsrc, gdst = nodes[elx[0]], nodes[elx[1]]
+            pos = lab > 0.5
+            assert ((gdst[pos] - gsrc[pos]) % N == 1).all()
+            assert ((gsrc >= 0) & (gsrc < N) & (gdst >= 0)
+                    & (gdst < N)).all()
+            npos_total += int(pos.sum())
+        assert npos_total == N
+        assert len(loader) == 4
+    finally:
+        loader.shutdown()
+
+
+def test_mp_subgraph_loader():
+    """Worker-mode induced-subgraph loader (cf. test_dist_subgraph_loader
+    semantics): every delivered edge is a true ring edge in graph-direction
+    COO, and every seed appears."""
+    from glt_tpu.distributed import DistSubGraphLoader
+
+    loader = DistSubGraphLoader(
+        [3], np.arange(N), batch_size=4, max_degree=8,
+        dataset_builder=build_ring_dataset,
+        worker_options=MpSamplingWorkerOptions(num_workers=2))
+    try:
+        seen = []
+        for batch in loader:
+            nodes = np.asarray(batch.node)
+            ei = np.asarray(batch.edge_index)
+            em = np.asarray(batch.edge_mask)
+            assert em.any()
+            for r, c in zip(ei[0][em], ei[1][em]):
+                assert (nodes[c] - nodes[r]) % N in (1, 2)
+            seen.extend(np.asarray(batch.batch)[:batch.batch_size].tolist())
+        assert sorted(seen) == list(range(N))
+        assert len(loader) == 6
+    finally:
+        loader.shutdown()
+
+
+def test_mp_node_kwargs_rejected():
+    """Loader-side knobs the workers can't honor must raise, not silently
+    change semantics between deployment modes."""
+    with pytest.raises(TypeError, match="as_pyg_v1"):
+        DistNeighborLoader(
+            [2], np.arange(N), dataset_builder=build_ring_dataset,
+            worker_options=MpSamplingWorkerOptions(num_workers=1),
+            as_pyg_v1=True)
