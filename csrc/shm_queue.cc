@@ -284,6 +284,9 @@ int glt_shmq_dequeue_alloc(void* qp, uint8_t** out, uint64_t* out_size,
     int rc = q_deadline_wait(&h->not_empty, h,
                              has_deadline ? &deadline : nullptr);
     if (rc == ETIMEDOUT) {
+      // POSIX allows a wakeup to race the deadline: recheck the predicate
+      // so an already-available message is never reported as a timeout.
+      if (h->head != h->tail) break;
       pthread_mutex_unlock(&h->mu);
       return 1;
     }

@@ -79,7 +79,7 @@ class _DistLoaderBase:
             self._producer = MpSamplingProducer(
                 dataset_builder, builder_args, num_neighbors, input_seeds,
                 batch_size, worker_options, self.channel, shuffle=shuffle,
-                kind=self._KIND, kind_kwargs=kind_kwargs or None)
+                kind=self._KIND, kind_kwargs=kind_kwargs or None, seed=seed)
             self._producer.init()
             self._num_batches = self._producer.num_expected()
         else:

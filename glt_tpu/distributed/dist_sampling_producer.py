@@ -126,6 +126,7 @@ class MpSamplingProducer:
         shuffle: bool = False,
         kind: str = "node",
         kind_kwargs: Optional[dict] = None,
+        seed: int = 0,
     ):
         self.kind = kind
         # The seed-edge arrays stay host-side in the producer; workers get
@@ -139,7 +140,10 @@ class MpSamplingProducer:
         self.options = options
         self.channel = channel
         self.shuffle = shuffle
-        self._rng = np.random.default_rng(options.worker_seed)
+        # Loader seed + options.worker_seed both feed the stream so mp mode
+        # honors per-loader seeding the way collocated mode does.
+        self._base_seed = int(options.worker_seed) + int(seed)
+        self._rng = np.random.default_rng(self._base_seed)
         self._ctx = mp.get_context("spawn")
         self._task_queues = []
         self._workers = []
@@ -154,7 +158,7 @@ class MpSamplingProducer:
         p = self._ctx.Process(
             target=_sampling_worker_loop,
             args=(w, builder, args, nn, self.batch_size, self.channel,
-                  tq, self.options.worker_seed, self.kind,
+                  tq, self._base_seed, self.kind,
                   self.kind_kwargs),
             daemon=True)
         p.start()

@@ -100,7 +100,10 @@ def shard_feature_tiered(feature: np.ndarray, num_shards: int,
     feature = np.asarray(feature)
     n, d = feature.shape
     c = -(-n // num_shards)
-    h = int(round(c * float(hot_ratio)))
+    # At least one hot row per shard: downstream exchange_gather_hot and
+    # make_tiered_train_step derive shapes/dtype from the hot array, and a
+    # [S, 0, d] hot tier would make jnp.take fail inside shard_map.
+    h = min(c, max(1, int(round(c * float(hot_ratio)))))
     hot = np.zeros((num_shards, h, d), feature.dtype)
     cold = np.zeros((num_shards, c - h, d), feature.dtype)
     for s in range(num_shards):

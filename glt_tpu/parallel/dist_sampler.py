@@ -58,10 +58,12 @@ def _bucket_by_owner(ids: jnp.ndarray, owner: jnp.ndarray, num_shards: int,
     sorted_ids = ids[order]
     sorted_owner = owner_key[order]
 
-    counts = jnp.sum(jax.nn.one_hot(owner_key, num_shards + 1,
-                                    dtype=jnp.int32), axis=0)
-    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                              jnp.cumsum(counts)[:-1]])
+    # Segment starts straight off the sorted owner keys — O(S log B)
+    # searchsorted instead of a dense [B, S+1] one-hot count, which at
+    # hop-2 frontier widths (50k+) dominated the exchange prologue.
+    starts = jnp.searchsorted(
+        sorted_owner, jnp.arange(num_shards + 1, dtype=sorted_owner.dtype)
+    ).astype(jnp.int32)
     rank = jnp.arange(b, dtype=jnp.int32) - starts[sorted_owner]
     rank = jnp.minimum(rank, cap - 1)
     sorted_slot = jnp.where(sorted_owner < num_shards,
@@ -497,7 +499,10 @@ class DistNeighborSampler:
             row modulo that shard's valid count."""
             ks, ku = jax.random.split(k)
             sh = jax.random.randint(ks, (n,), 0, s_count, dtype=jnp.int32)
-            u = jax.random.randint(ku, (n,), 0, c, dtype=jnp.int32)
+            # Draw over the full int31 range before the modulo so the bias
+            # toward low rows is O(count / 2^31) instead of O(count / c).
+            u = jax.random.randint(ku, (n,), 0, jnp.int32(2**31 - 1),
+                                   dtype=jnp.int32)
             return sh * c + u % jnp.maximum(counts[sh], 1)
 
         if mode == "binary":
