@@ -31,6 +31,7 @@ class HeteroLinkNeighborLoader(HeteroNeighborLoader):
         batch_size: int = 512,
         shuffle: bool = False,
         drop_last: bool = False,
+        frontier_cap: Optional[int] = None,
         prefetch: int = 2,
         seed: int = 0,
     ):
@@ -38,7 +39,7 @@ class HeteroLinkNeighborLoader(HeteroNeighborLoader):
         eli = np.asarray(eli)
         sampler = HeteroNeighborSampler(
             data.graph, num_neighbors, edge_type[0],
-            batch_size=batch_size, seed=seed)
+            batch_size=batch_size, frontier_cap=frontier_cap, seed=seed)
         super().__init__(data, num_neighbors,
                          (edge_type[0], np.arange(eli.shape[1])),
                          batch_size=batch_size, shuffle=shuffle,

@@ -28,6 +28,7 @@ class HeteroNeighborLoader:
         batch_size: int = 512,
         shuffle: bool = False,
         drop_last: bool = False,
+        frontier_cap: Optional[int] = None,
         prefetch: int = 2,
         seed: int = 0,
         sampler: Optional[HeteroNeighborSampler] = None,
@@ -49,7 +50,8 @@ class HeteroNeighborLoader:
         if sampler is None:
             sampler = HeteroNeighborSampler(
                 data.graph, num_neighbors, input_type,
-                batch_size=batch_size, seed=seed)
+                batch_size=batch_size, frontier_cap=frontier_cap,
+                seed=seed)
         self.sampler = sampler
 
     def __len__(self) -> int:

@@ -16,7 +16,9 @@ from .dist_hetero_sampler import DistHeteroNeighborSampler, shard_hetero_graph
 from .dist_train import (
     TieredTrainPipeline,
     init_dist_state,
+    init_hetero_dist_state,
     make_dist_train_step,
+    make_hetero_dist_train_step,
     make_tiered_train_step,
 )
 
@@ -34,7 +36,9 @@ __all__ = [
     "exchange_gather_hot",
     "exchange_one_hop",
     "init_dist_state",
+    "init_hetero_dist_state",
     "make_dist_train_step",
+    "make_hetero_dist_train_step",
     "make_tiered_train_step",
     "shard_feature",
     "shard_feature_tiered",

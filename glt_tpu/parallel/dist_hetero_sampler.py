@@ -51,6 +51,7 @@ class DistHeteroNeighborSampler:
     def __init__(self, sharded: Dict[EdgeType, ShardedGraph], mesh: Mesh,
                  num_neighbors, input_type: NodeType,
                  batch_size: int = 512, axis_name: str = "shard",
+                 frontier_cap: Optional[int] = None,
                  seed: int = 0):
         self.sharded = sharded
         self.mesh = mesh
@@ -76,7 +77,7 @@ class DistHeteroNeighborSampler:
 
         self._widths, self._capacity = hetero_hop_widths(
             p.edge_types, p.num_neighbors, {input_type: self.batch_size},
-            p.num_hops)
+            p.num_hops, frontier_cap=frontier_cap)
 
         gspec = P(axis_name)
         arrays = {et: (g.indptr, g.indices, g.edge_ids)

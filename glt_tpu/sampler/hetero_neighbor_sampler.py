@@ -32,6 +32,7 @@ def hetero_hop_widths(
     num_neighbors: Dict[EdgeType, List[int]],
     seed_widths: Dict[NodeType, int],
     num_hops: int,
+    frontier_cap: Optional[int] = None,
 ) -> Tuple[List[Dict[NodeType, int]], Dict[NodeType, int]]:
     """Static frontier width per (hop, node type) + total capacity per type.
 
@@ -40,6 +41,12 @@ def hetero_hop_widths(
     hop ``i-1`` across all edge types ending in ``t``.  ``seed_widths``
     gives the hop-0 frontier per type (node sampling seeds one type; link
     sampling seeds the edge's endpoint types).
+
+    ``frontier_cap`` bounds each (hop, type) frontier, exactly like the
+    homo sampler's knob (neighbor_sampler.py ``hop_widths``): without it,
+    widths multiply across edge types per hop and IGBH-scale fanouts
+    explode trace-time capacities.  Newly-discovered nodes beyond the cap
+    don't expand further hops (they stay in the node set).
     """
     ntypes = sorted({et[0] for et in edge_types} | {et[2] for et in edge_types}
                     | set(seed_widths))
@@ -51,6 +58,8 @@ def hetero_hop_widths(
             fanouts = num_neighbors[et]
             if hop < len(fanouts) and fanouts[hop] > 0:
                 nxt[et[2]] += widths[hop][et[0]] * fanouts[hop]
+        if frontier_cap is not None:
+            nxt = {t: min(w, frontier_cap) for t, w in nxt.items()}
         widths.append(nxt)
     capacity = {t: sum(w[t] for w in widths) for t in ntypes}
     return widths, capacity
@@ -73,6 +82,7 @@ class HeteroNeighborSampler(BaseSampler):
         num_neighbors,
         input_type: NodeType,
         batch_size: int = 512,
+        frontier_cap: Optional[int] = None,
         seed: int = 0,
     ):
         self.graphs = graphs
@@ -89,9 +99,11 @@ class HeteroNeighborSampler(BaseSampler):
         self._base_key = jax.random.PRNGKey(seed)
         self._call_count = 0
 
+        self.frontier_cap = frontier_cap
         self._widths, self._capacity = hetero_hop_widths(
             self.edge_types, self.num_neighbors,
-            {input_type: self.batch_size}, self.num_hops)
+            {input_type: self.batch_size}, self.num_hops,
+            frontier_cap=frontier_cap)
         self.node_types = sorted(self._capacity.keys())
         self._sample_jit = jax.jit(
             partial(self._sample_impl, self._widths, self._capacity))
@@ -173,13 +185,17 @@ class HeteroNeighborSampler(BaseSampler):
                     out, src_local, w, f = hop_out[et]
                     nbr_local = merged.inverse[off: off + w * f].reshape(w, f)
                     off += w * f
-                    nbr_local = jnp.where(out.mask, nbr_local, PADDING_ID)
+                    # With a frontier_cap the unique buffer can fill before
+                    # every candidate lands; edges to dropped nodes must be
+                    # masked, or nbr_local would index past the buffer.
+                    ok = out.mask & (nbr_local < buflen)
+                    nbr_local = jnp.where(ok, nbr_local, PADDING_ID)
                     # reversed edge type, transposed direction
                     rows[et].append(nbr_local.ravel())
                     cols[et].append(
                         jnp.broadcast_to(src_local[:, None], (w, f)).ravel())
                     eids[et].append(out.eids.ravel())
-                    emasks[et].append(out.mask.ravel())
+                    emasks[et].append(ok.ravel())
 
                 old_count = count[t]
                 nw = widths[hop + 1][t]
@@ -298,7 +314,7 @@ class HeteroNeighborSampler(BaseSampler):
                            else {src_t: sw, dst_t: dw})
             widths, cap = hetero_hop_widths(
                 self.edge_types, self.num_neighbors, seed_widths,
-                self.num_hops)
+                self.num_hops, frontier_cap=self.frontier_cap)
 
             # Node counts are static: an edge type's CSR rows are its
             # source type's nodes.

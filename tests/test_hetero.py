@@ -207,3 +207,34 @@ class TestHeteroLink:
             umask = np.asarray(batch.node_mask["user"])
             np.testing.assert_allclose(xu[umask][:, 0], users[umask])
         assert n == 3
+
+
+class TestFrontierCap:
+    def test_capped_widths(self):
+        from glt_tpu.sampler.hetero_neighbor_sampler import hetero_hop_widths
+        widths, cap = hetero_hop_widths(
+            [ET_UI, ET_IU], {ET_UI: [4, 4], ET_IU: [4, 4]},
+            {"user": 8}, 2, frontier_cap=16)
+        assert all(w <= 16 for hop in widths for w in hop.values())
+        assert cap["user"] <= 8 + 16 + 16 and cap["item"] <= 16 + 16
+
+    def test_capped_sampling_still_valid(self):
+        """Edges emitted under a tight cap must still verify against the
+        graph, and nbr locals must stay inside the (smaller) node buffer."""
+        ds = hetero_dataset()
+        samp = HeteroNeighborSampler(ds.graph, [2, 2], "user",
+                                     batch_size=3, frontier_cap=4)
+        out = samp.sample_from_nodes(NodeSamplerInput(np.array([0, 5, 9])))
+        for et in (ET_UI, ET_IU):
+            rev_src = np.asarray(out.node[et[2]])   # reversed key: src=nbr
+            rev_dst = np.asarray(out.node[et[0]])
+            from glt_tpu.typing import reverse_edge_type
+            rk = reverse_edge_type(et)
+            m = np.asarray(out.edge_mask[rk])
+            row = np.asarray(out.row[rk])
+            col = np.asarray(out.col[rk])
+            assert (row[m] < rev_src.shape[0]).all()
+            assert (row[m] >= 0).all()
+            for r, c in zip(row[m], col[m]):
+                assert edge_ok(et, rev_dst[c], rev_src[r]), (et, rev_dst[c],
+                                                             rev_src[r])
