@@ -5,12 +5,32 @@ between the sampling producer and the trainer.
 """
 from __future__ import annotations
 
+import queue
+import threading
 from abc import ABC, abstractmethod
 from typing import Dict
 
 import numpy as np
 
 SampleMessage = Dict[str, np.ndarray]
+
+
+def bounded_put(q: "queue.Queue", item, stop: threading.Event,
+                timeout: float = 0.5) -> bool:
+    """Put into a bounded queue, giving up when ``stop`` is set.
+
+    Shared by both ends of the server-client protocol (the server's
+    producer buffer and the client's prefetch queue) so a producer whose
+    consumer vanished exits instead of wedging on a full queue.  Returns
+    False iff stopped before the item was enqueued.
+    """
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=timeout)
+            return True
+        except queue.Full:
+            continue
+    return False
 
 
 class ChannelBase(ABC):

@@ -86,6 +86,11 @@ class Feature:
     def id2index(self):
         return self._id2index
 
+    @property
+    def hot_rows(self) -> jnp.ndarray:
+        """The HBM-resident hot tier ``[hot_count, d]`` as a jax.Array."""
+        return self._hot
+
     # -- gather ------------------------------------------------------------
     def gather(self, ids: jnp.ndarray) -> jnp.ndarray:
         """Gather rows for global ``ids`` (-1 padded).

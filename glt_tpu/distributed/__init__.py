@@ -1,6 +1,7 @@
 from .dist_options import (
     CollocatedSamplingWorkerOptions,
     MpSamplingWorkerOptions,
+    RemoteSamplingWorkerOptions,
 )
 from .dist_dataset import DistDataset
 from .dist_loader import (
@@ -17,6 +18,7 @@ __all__ = [
     "DistNeighborLoader",
     "DistSubGraphLoader",
     "MpSamplingWorkerOptions",
+    "RemoteSamplingWorkerOptions",
     "batch_to_message",
     "message_to_batch",
 ]

@@ -8,6 +8,7 @@ RemoteDistSamplingWorkerOptions, dist_options.py:202-254).
 """
 from __future__ import annotations
 
+import dataclasses
 import json
 import queue
 import socket
@@ -16,6 +17,7 @@ from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..channel.base import bounded_put
 from ..channel.serialization import deserialize
 from ..loader.transform import Batch
 from .dist_server import _KIND_JSON, _KIND_MSG, recv_frame, send_frame
@@ -67,19 +69,33 @@ class RemoteNeighborLoader:
         num_neighbors: Sequence[int],
         input_nodes: np.ndarray,
         batch_size: int = 512,
-        prefetch: int = 4,
+        prefetch: Optional[int] = None,
         seed: int = 0,
+        worker_options=None,
     ):
+        from .dist_options import RemoteSamplingWorkerOptions
+
+        opts = worker_options or RemoteSamplingWorkerOptions()
+        if not isinstance(opts, RemoteSamplingWorkerOptions):
+            raise TypeError(
+                f"worker_options must be RemoteSamplingWorkerOptions, got "
+                f"{type(opts).__name__}")
+        # An explicit ``prefetch`` argument wins over the options default.
+        if prefetch is not None:
+            opts = dataclasses.replace(opts, prefetch_size=int(prefetch))
         self.conn = RemoteServerConnection(server_addr)
         resp = self.conn.request(
             op="create_sampling_producer",
             num_neighbors=list(num_neighbors),
             input_nodes=np.asarray(input_nodes).tolist(),
             batch_size=int(batch_size),
-            seed=seed)
+            seed=seed + opts.worker_seed,
+            num_workers=int(opts.num_workers),
+            buffer_capacity=int(opts.buffer_capacity),
+            channel_capacity_bytes=int(opts.channel_capacity_bytes))
         self.producer_id = resp["producer_id"]
         self.num_expected = resp["num_expected"]
-        self.prefetch = max(1, int(prefetch))
+        self.prefetch = max(1, int(opts.prefetch_size))
 
     def __len__(self) -> int:
         return self.num_expected
@@ -87,20 +103,34 @@ class RemoteNeighborLoader:
     def __iter__(self) -> Iterator[Batch]:
         self.conn.request(op="start_new_epoch_sampling",
                           producer_id=self.producer_id)
-        buf: "queue.Queue" = queue.Queue()
+        # Bounded to the configured prefetch depth: a slow trainer holds at
+        # most ``prefetch`` unconsumed messages instead of buffering the
+        # whole epoch in client RAM (the reference's prefetch_size
+        # semantics, channel/remote_channel.py:24-85).
+        buf: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
 
         def prefetcher():
-            for _ in range(self.num_expected):
-                if stop.is_set():
-                    return
-                buf.put(self.conn.fetch_message(self.producer_id))
+            # A fetch error (dead server, socket timeout) is forwarded to
+            # the consumer instead of dying silently in this thread and
+            # leaving the consumer blocked forever on buf.get().
+            try:
+                for _ in range(self.num_expected):
+                    msg = self.conn.fetch_message(self.producer_id)
+                    if not bounded_put(buf, msg, stop):
+                        return
+            except Exception as e:  # noqa: BLE001 — relayed to consumer
+                bounded_put(buf, e, stop)
 
         t = threading.Thread(target=prefetcher, daemon=True)
         t.start()
         try:
             for _ in range(self.num_expected):
-                yield message_to_batch(buf.get())
+                item = buf.get()
+                if isinstance(item, Exception):
+                    raise RuntimeError(
+                        f"remote sampling prefetch failed: {item}") from item
+                yield message_to_batch(item)
         finally:
             stop.set()
 

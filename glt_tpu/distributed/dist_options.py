@@ -3,8 +3,10 @@
 The reference selects its loader mode by option type (dist_loader.py:
 142-221): collocated (sync in-process), mp (sampling subprocesses + shm
 channel), or remote (server-side producers).  The TPU build keeps the same
-pattern; 'remote' is intentionally absent this round — on TPU, remote
-sampling maps to separate host processes feeding the same shm channel.
+pattern; the remote mode's options are
+:class:`RemoteSamplingWorkerOptions`, consumed by
+:class:`~glt_tpu.distributed.dist_client.RemoteNeighborLoader` and
+forwarded to the server's producer factory.
 """
 from __future__ import annotations
 
@@ -30,3 +32,29 @@ class MpSamplingWorkerOptions:
     # Trainer-side recv timeout (seconds) between worker-liveness checks;
     # bounds how long a mid-epoch worker death can stall the epoch.
     heartbeat_secs: float = 5.0
+
+
+@dataclasses.dataclass
+class RemoteSamplingWorkerOptions:
+    """Sample on a remote server; producers run there, batches stream back.
+
+    Mirrors ``RemoteDistSamplingWorkerOptions`` (dist_options.py:202-254):
+    the client sets the server-side producer shape (worker count, buffer
+    bounds) and its own prefetch depth.
+
+    Attributes:
+      num_workers: sampling subprocesses the server spawns for this
+        producer (0 = one in-server thread; >0 needs the server to have
+        been started with a picklable ``dataset_builder``).
+      buffer_capacity: server-side bounded buffer, in messages (the
+        reference's per-producer shm buffer capacity).
+      channel_capacity_bytes: shm ring size for the server's mp workers.
+      prefetch_size: client-side prefetch depth — at most this many
+        fetched-but-unconsumed messages are held by the loader (the
+        reference's RemoteReceivingChannel prefetch, remote_channel.py:24).
+    """
+    num_workers: int = 0
+    buffer_capacity: int = 8
+    channel_capacity_bytes: int = 64 * 1024 * 1024
+    prefetch_size: int = 4
+    worker_seed: int = 0

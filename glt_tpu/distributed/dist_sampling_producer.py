@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import threading
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -151,6 +152,10 @@ class MpSamplingProducer:
         self._delivered = []
         self._builder = (dataset_builder, builder_args, list(num_neighbors))
         self.max_respawns = 3
+        # Cooperative stop for consumers blocked in iter_messages (e.g. a
+        # server forwarder thread): set before shutdown() so the iterator
+        # exits instead of treating the stopped workers as crashed.
+        self._stopping = threading.Event()
 
     def _spawn(self, w: int):
         builder, args, nn = self._builder
@@ -231,6 +236,8 @@ class MpSamplingProducer:
         got = 0
         fruitless_respawns = 0
         while got < total:
+            if self._stopping.is_set():
+                return
             msg = self.channel.recv(timeout=self.options.heartbeat_secs)
             if msg is not None:
                 self._account(msg)
@@ -276,6 +283,7 @@ class MpSamplingProducer:
             self._delivered[int(np.asarray(tag).ravel()[0])] += 1
 
     def shutdown(self) -> None:
+        self._stopping.set()
         for tq in self._task_queues:
             try:
                 tq.put((_CMD_STOP, None))

@@ -24,6 +24,7 @@ from typing import Callable, Dict, Optional, Sequence
 
 import numpy as np
 
+from ..channel.base import bounded_put
 from ..channel.serialization import deserialize, serialize
 
 _KIND_JSON = 0
@@ -54,66 +55,147 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 
 class _Producer:
-    """Server-side sampling producer: a thread filling a bounded queue
-    (the reference's producer + shm buffer pair, dist_server.py:83-116)."""
+    """Server-side sampling producer filling a bounded buffer
+    (the reference's producer + shm buffer pair, dist_server.py:83-116).
+
+    Two backends, chosen by ``num_workers``:
+      * 0 — one in-server thread driving a collocated NeighborLoader;
+      * >0 — an :class:`MpSamplingProducer` worker fleet feeding a shm
+        ring (the reference's mp producer pool on the server,
+        dist_server.py:83-116), drained into the bounded buffer by a
+        forwarder thread.  Requires the server's picklable
+        ``dataset_builder``.
+    """
 
     def __init__(self, dataset, num_neighbors, input_nodes, batch_size,
-                 buffer_capacity: int = 8, seed: int = 0):
-        from ..loader.node_loader import NeighborLoader
-
-        self.loader = NeighborLoader(dataset, num_neighbors,
-                                     input_nodes, batch_size=batch_size,
-                                     shuffle=True, seed=seed)
+                 buffer_capacity: int = 8, seed: int = 0,
+                 num_workers: int = 0, dataset_builder=None,
+                 builder_args: tuple = (),
+                 channel_capacity_bytes: int = 64 * 1024 * 1024):
         self.buffer: "queue.Queue" = queue.Queue(maxsize=buffer_capacity)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._mp_producer = None
+        self._channel = None
+        if num_workers > 0:
+            if dataset_builder is None:
+                raise ValueError(
+                    "num_workers > 0 needs the server started with a "
+                    "picklable dataset_builder (init_server(..., "
+                    "dataset_builder=...))")
+            from ..channel import ShmChannel
+            from .dist_options import MpSamplingWorkerOptions
+            from .dist_sampling_producer import MpSamplingProducer
+
+            self._channel = ShmChannel(
+                capacity_bytes=channel_capacity_bytes)
+            self._mp_producer = MpSamplingProducer(
+                dataset_builder, builder_args, list(num_neighbors),
+                np.asarray(input_nodes, np.int64), int(batch_size),
+                MpSamplingWorkerOptions(num_workers=num_workers),
+                self._channel, shuffle=True, seed=seed)
+            self._mp_producer.init()
+            nbatches = self._mp_producer.num_expected()
+        else:
+            from ..loader.node_loader import NeighborLoader
+
+            self.loader = NeighborLoader(dataset, num_neighbors,
+                                         input_nodes, batch_size=batch_size,
+                                         shuffle=True, seed=seed)
+            nbatches = len(self.loader)
+        self._num_expected = nbatches
 
     def num_expected(self) -> int:
-        return len(self.loader)
+        return self._num_expected
 
     def start_epoch(self) -> None:
         if self._thread is not None:
-            # The previous epoch's producer may still be draining its last
-            # put even after the client consumed every batch — wait for it
-            # rather than racing.
+            # Tell the previous epoch's thread to stop before joining: a
+            # client that abandoned its epoch mid-way (early stopping)
+            # leaves the thread wedged on the bounded buffer, and without
+            # the stop signal this join would block 60s and then poison
+            # the producer.
+            self._stop.set()
             self._thread.join(timeout=60)
             if self._thread.is_alive():
                 raise RuntimeError("previous epoch still producing")
         self._stop.clear()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        # Drop anything a previous epoch left behind (in particular a
+        # relayed error the client never fetched) so it cannot poison
+        # this epoch's first fetch.
+        while True:
+            try:
+                self.buffer.get_nowait()
+            except queue.Empty:
+                break
+        if self._mp_producer is not None:
+            self._mp_producer.produce_all()
+            self._thread = threading.Thread(target=self._forward_mp,
+                                            daemon=True)
+        else:
+            self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     def _run(self) -> None:
         from .sample_message import batch_to_message
 
-        for batch in self.loader:
-            payload = serialize(batch_to_message(batch))
-            # put with a stop check so a producer whose client vanished
-            # mid-epoch can exit instead of wedging on the bounded buffer
-            # (and permanently poisoning this producer id).
-            while not self._stop.is_set():
-                try:
-                    self.buffer.put(payload, timeout=0.5)
-                    break
-                except queue.Full:
-                    continue
-            if self._stop.is_set():
-                return
+        # Loader failures are relayed to the fetching client (same
+        # contract as _forward_mp) instead of dying silently here.
+        try:
+            for batch in self.loader:
+                # stop-aware put so a producer whose client vanished
+                # mid-epoch exits instead of wedging on the bounded buffer
+                # (and permanently poisoning this producer id).
+                if not bounded_put(self.buffer,
+                                   serialize(batch_to_message(batch)),
+                                   self._stop):
+                    return
+        except Exception as e:  # noqa: BLE001 — relayed to client
+            bounded_put(self.buffer, e, self._stop)
+
+    def _forward_mp(self) -> None:
+        # iter_messages raises after max_respawns of fruitless worker
+        # deaths; relay that to the fetching client instead of discarding
+        # it in this daemon thread (which would hang the client forever).
+        try:
+            for msg in self._mp_producer.iter_messages():
+                if not bounded_put(self.buffer, serialize(msg), self._stop):
+                    return
+        except Exception as e:  # noqa: BLE001 — relayed to client
+            bounded_put(self.buffer, e, self._stop)
 
     def fetch(self) -> bytes:
-        return self.buffer.get()
+        item = self.buffer.get()
+        if isinstance(item, Exception):
+            raise RuntimeError(f"server-side sampling failed: {item}")
+        return item
 
     def stop(self) -> None:
         self._stop.set()
+        if self._mp_producer is not None:
+            # Order matters: shutdown() first sets the producer's stopping
+            # flag so a forwarder blocked in channel.recv exits at its next
+            # timeout, THEN the thread is joined, and the shm segment is
+            # only unlinked once the forwarder is provably out of recv —
+            # closing under its feet would be a native use-after-free.
+            self._mp_producer.shutdown()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            self._thread.join(timeout=30)
+        if self._mp_producer is not None:
+            if self._thread is None or not self._thread.is_alive():
+                self._channel.close()
+            # else: leak the segment rather than unmap it under a live
+            # reader; the process exiting reclaims it.
 
 
 class DistServer:
     """Args mirror init_server (dist_server.py:158-190)."""
 
-    def __init__(self, dataset, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, dataset, host: str = "127.0.0.1", port: int = 0,
+                 dataset_builder=None, builder_args: tuple = ()):
         self.dataset = dataset
+        self._dataset_builder = dataset_builder
+        self._builder_args = builder_args
         self._producers: Dict[int, _Producer] = {}
         self._next_id = 0
         self._lock = threading.Lock()
@@ -134,17 +216,26 @@ class DistServer:
             g = self.dataset.get_graph()
             return {"num_nodes": g.num_nodes, "num_edges": g.num_edges}
         if op == "create_sampling_producer":
+            # Construct outside the lock: mp-producer setup (process spawn
+            # + dataset rebuild) can take seconds and must not stall other
+            # clients' create/destroy requests.
+            prod = _Producer(
+                self.dataset, req["num_neighbors"],
+                np.asarray(req["input_nodes"], np.int64),
+                req["batch_size"],
+                buffer_capacity=req.get("buffer_capacity", 8),
+                seed=req.get("seed", 0),
+                num_workers=req.get("num_workers", 0),
+                dataset_builder=self._dataset_builder,
+                builder_args=self._builder_args,
+                channel_capacity_bytes=req.get(
+                    "channel_capacity_bytes", 64 * 1024 * 1024))
             with self._lock:
                 pid = self._next_id
                 self._next_id += 1
-                self._producers[pid] = _Producer(
-                    self.dataset, req["num_neighbors"],
-                    np.asarray(req["input_nodes"], np.int64),
-                    req["batch_size"],
-                    buffer_capacity=req.get("buffer_capacity", 8),
-                    seed=req.get("seed", 0))
+                self._producers[pid] = prod
             return {"producer_id": pid,
-                    "num_expected": self._producers[pid].num_expected()}
+                    "num_expected": prod.num_expected()}
         if op == "start_new_epoch_sampling":
             self._producers[req["producer_id"]].start_epoch()
             return {"ok": True}
@@ -198,12 +289,29 @@ class DistServer:
 
     def shutdown(self) -> None:
         self._stop.set()
+        # Stop every live producer: with the mp backend each one owns a
+        # worker-process fleet and a shm ring that would otherwise outlive
+        # the client that forgot to destroy it.
+        with self._lock:
+            producers = list(self._producers.values())
+            self._producers.clear()
+        for prod in producers:
+            prod.stop()
         try:
             self._sock.close()
         except OSError:
             pass
 
 
-def init_server(dataset, host: str = "127.0.0.1", port: int = 0
+def init_server(dataset, host: str = "127.0.0.1", port: int = 0,
+                dataset_builder=None, builder_args: tuple = ()
                 ) -> DistServer:
-    return DistServer(dataset, host=host, port=port)
+    """Start a sampling server (cf. init_server, dist_server.py:158-190).
+
+    Pass a picklable ``dataset_builder`` (+``builder_args``) to enable
+    mp producer pools for clients requesting
+    ``RemoteSamplingWorkerOptions(num_workers > 0)``.
+    """
+    return DistServer(dataset, host=host, port=port,
+                      dataset_builder=dataset_builder,
+                      builder_args=builder_args)

@@ -102,13 +102,42 @@ class DistHeteroNeighborSampler:
             g.num_shards, fanout, key, self.axis_name)
         return NeighborOutput(nbrs=nbrs, eids=eids, mask=mask)
 
+    def local_sample(self, arrays, seeds, key):
+        """Multi-hop hetero sample from inside an enclosing shard_map.
+
+        Public seam for fused train steps
+        (:func:`~glt_tpu.parallel.dist_train.make_hetero_dist_train_step`):
+        ``arrays`` is the per-shard ``{etype: (indptr, indices, edge_ids)}``
+        view, ``seeds`` the local ``[batch]`` seed ids of ``input_type``,
+        ``key`` already folded with the shard's axis index.
+        """
+        return self._planner._sample_impl(
+            self._widths, self._capacity, arrays,
+            {self.input_type: seeds}, key, one_hop=self._one_hop)
+
+    @property
+    def edge_types(self):
+        return list(self._planner.edge_types)
+
+    @property
+    def num_neighbors(self):
+        return {et: list(v) for et, v in self._planner.num_neighbors.items()}
+
+    @property
+    def node_capacity(self):
+        """Static per-node-type unique-node capacity of one local sample."""
+        return dict(self._capacity)
+
+    @property
+    def hop_widths(self):
+        """Per-hop per-node-type frontier widths (static trace shapes)."""
+        return [dict(w) for w in self._widths]
+
     def _local_body(self, arrays_blk, seeds_blk, key):
         arrays = jax.tree.map(lambda x: x[0], arrays_blk)
         seeds = seeds_blk[0]
         key = jax.random.fold_in(key, lax.axis_index(self.axis_name))
-        out = self._planner._sample_impl(
-            self._widths, self._capacity, arrays,
-            {self.input_type: seeds}, key, one_hop=self._one_hop)
+        out = self.local_sample(arrays, seeds, key)
         return jax.tree.map(lambda x: x[None], out)
 
     def sample_from_nodes(self, seeds_per_shard: jnp.ndarray,

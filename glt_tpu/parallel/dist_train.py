@@ -298,10 +298,7 @@ def make_hetero_dist_train_step(
         key = jax.random.fold_in(key, lax.axis_index(axis_name))
         kdrop, ksample = jax.random.split(key)
 
-        p = sampler._planner
-        out = p._sample_impl(sampler._widths, sampler._capacity, arrays_l,
-                             {tgt: seeds}, ksample,
-                             one_hop=sampler._one_hop)
+        out = sampler.local_sample(arrays_l, seeds, ksample)
         x = {t: exchange_gather(out.node[t], rows_l[t], meta[t][0],
                                 meta[t][1], axis_name)
              for t in rows_l}
@@ -347,15 +344,16 @@ def make_hetero_dist_train_step(
 def init_hetero_dist_state(model, tx, sampler, feats,
                            rng: jax.Array) -> TrainState:
     """Replicated params/opt-state from the sampler's static shapes."""
-    p = sampler._planner
-    x = {t: jnp.zeros((max(sampler._capacity[t], 1),
+    capacity = sampler.node_capacity
+    widths = sampler.hop_widths
+    x = {t: jnp.zeros((max(capacity[t], 1),
                        feats[t].rows.shape[-1]), feats[t].rows.dtype)
          for t in feats}
     ei, mask = {}, {}
     from ..typing import reverse_edge_type
-    for et in p.edge_types:
-        fanouts = p.num_neighbors[et]
-        ecap = sum(sampler._widths[hop][et[0]] * f
+    for et in sampler.edge_types:
+        fanouts = sampler.num_neighbors[et]
+        ecap = sum(widths[hop][et[0]] * f
                    for hop, f in enumerate(fanouts) if f > 0)
         rev = reverse_edge_type(et)
         ei[rev] = jnp.full((2, max(ecap, 1)), PADDING_ID, jnp.int32)
