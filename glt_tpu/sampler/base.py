@@ -48,15 +48,32 @@ class NegativeSampling:
     mode 'binary': per positive edge, ``amount`` negative edges are drawn and
     labeled 0 (positives get 1).  mode 'triplet': per positive edge,
     ``amount`` negative *destination* nodes are drawn for each source.
+
+    ``weight`` is an optional node-level vector biasing the negative node
+    draws (need not sum to one; the reference's ``NegativeSampling.weight``,
+    sampler/base.py:101-106).  Uniform when absent.  On hetero graphs the
+    weight indexes the *destination* node type.
     """
     MODES = ("binary", "triplet")
 
-    def __init__(self, mode: str = "binary", amount: float = 1):
+    def __init__(self, mode: str = "binary", amount: float = 1,
+                 weight=None):
         mode = mode.lower()
         if mode not in self.MODES:
             raise ValueError(f"mode must be one of {self.MODES}, got {mode!r}")
         self.mode = mode
         self.amount = amount
+        self.weight = None if weight is None else np.asarray(weight,
+                                                             np.float32)
+        if self.weight is not None:
+            if (self.weight < 0).any():
+                raise ValueError("negative-sampling weight must be >= 0")
+            if float(self.weight.sum()) <= 0.0:
+                # An all-zero weight would make the CDF 0/0 = NaN and every
+                # draw silently collapse to node 0.
+                raise ValueError("negative-sampling weight must have a "
+                                 "positive sum")
+        self._cdf = None
 
     def is_binary(self) -> bool:
         return self.mode == "binary"
@@ -66,6 +83,16 @@ class NegativeSampling:
 
     def sample_count(self, num_pos: int) -> int:
         return int(round(num_pos * self.amount))
+
+    def cdf(self):
+        """Normalized cumulative weight (device array), or None."""
+        if self.weight is None:
+            return None
+        if self._cdf is None:
+            from ..ops.negative_sample import weight_to_cdf
+
+            self._cdf = weight_to_cdf(self.weight)
+        return self._cdf
 
 
 @dataclasses.dataclass

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data.graph import Graph
+from ..ops.negative_sample import sample_negative_edges, weighted_draw
 from ..ops.neighbor_sample import sample_neighbors
 from ..ops.unique import unique_first_occurrence
 from ..typing import EdgeType, NodeType, PADDING_ID, reverse_edge_type
@@ -261,10 +262,12 @@ class HeteroNeighborSampler(BaseSampler):
                           ) -> HeteroSamplerOutput:
         """Seed-edge sampling with optional binary/triplet negatives.
 
-        Negatives are drawn non-strict (uniform destination-type nodes),
-        matching the reference's distributed non-strict mode
-        (dist_neighbor_sampler.py:327-453); strict rejection needs the
-        per-type sorted-column view and lands with weighted sampling.
+        Binary negatives are drawn **strict** — rejection-tested against
+        the seed edge type's CSR via its sorted-column view, the hetero
+        analog of the CUDA strict mode (random_negative_sampler.cu:37-54)
+        — with the reference's non-strict padding fallback.  An optional
+        ``NegativeSampling.weight`` biases negative draws over the
+        destination node type.
         """
         et = inputs.input_type
         if et is None:
@@ -279,11 +282,17 @@ class HeteroNeighborSampler(BaseSampler):
 
         mode = None if neg is None else neg.mode
         amount = 0 if neg is None else int(round(neg.amount))
-        fn = self._get_edges_jit(et, mode, amount)
+        cdf = None if neg is None else neg.cdf()
+        fn = self._get_edges_jit(et, mode, amount, cdf is not None)
         graph_arrays = {
             e: (g.indptr, g.indices, g.edge_ids)
             for e, g in self.graphs.items()}
-        out = fn(graph_arrays, jnp.asarray(src), jnp.asarray(dst), key)
+        seed_g = self.graphs[et]
+        sorted_idx = (seed_g.sorted_indices if mode == "binary"
+                      else seed_g.indices)
+        out = fn(graph_arrays, sorted_idx, jnp.asarray(src),
+                 jnp.asarray(dst),
+                 jnp.zeros((1,), jnp.float32) if cdf is None else cdf, key)
 
         if mode == "binary":
             label = inputs.label
@@ -299,8 +308,8 @@ class HeteroNeighborSampler(BaseSampler):
                 jnp.asarray(src) >= 0, label, PADDING_ID)
         return out
 
-    def _get_edges_jit(self, et, mode, amount):
-        k = (et, mode, amount)
+    def _get_edges_jit(self, et, mode, amount, weighted: bool = False):
+        k = (et, mode, amount, weighted)
         if k not in self._edges_jit:
             src_t, _, dst_t = et
             q = self.batch_size
@@ -326,19 +335,25 @@ class HeteroNeighborSampler(BaseSampler):
                     f"{dst_t!r} (needed for its node count)")
             n_dst = self.graphs[dst_rows[0]].num_nodes
 
-            def impl(graph_arrays, src, dst, key):
+            def impl(graph_arrays, sorted_idx, src, dst, cdf, key):
                 kneg, ksample = jax.random.split(key)
+                dst_cdf = cdf if weighted else None
                 if mode == "binary":
-                    ks, kd = jax.random.split(kneg)
-                    neg_src = jax.random.randint(ks, (q * amount,), 0,
-                                                 n_src, dtype=jnp.int32)
-                    neg_dst = jax.random.randint(kd, (q * amount,), 0,
-                                                 n_dst, dtype=jnp.int32)
-                    srcs = jnp.concatenate([src, neg_src])
-                    dsts = jnp.concatenate([dst, neg_dst])
+                    # Strict rejection against the seed edge type's CSR
+                    # (sorted-column binary search), weighted dst draws
+                    # when NegativeSampling.weight is set.
+                    et_indptr = graph_arrays[et][0]
+                    negs = sample_negative_edges(
+                        et_indptr, sorted_idx, q * amount, kneg, n_src,
+                        num_dst_nodes=n_dst, dst_cdf=dst_cdf)
+                    srcs = jnp.concatenate([src, negs.src])
+                    dsts = jnp.concatenate([dst, negs.dst])
                 elif mode == "triplet":
-                    neg_dst = jax.random.randint(kneg, (q * amount,), 0,
-                                                 n_dst, dtype=jnp.int32)
+                    if weighted:
+                        neg_dst = weighted_draw(kneg, cdf, (q * amount,))
+                    else:
+                        neg_dst = jax.random.randint(kneg, (q * amount,), 0,
+                                                     n_dst, dtype=jnp.int32)
                     neg_dst = jnp.where(jnp.repeat(src >= 0, amount),
                                         neg_dst, PADDING_ID)
                     srcs, dsts = src, jnp.concatenate([dst, neg_dst])

@@ -37,7 +37,7 @@ import numpy as np
 
 from ..data.graph import Graph
 from ..ops.neighbor_sample import sample_neighbors
-from ..ops.negative_sample import sample_negative_edges
+from ..ops.negative_sample import sample_negative_edges, weighted_draw
 from ..ops.subgraph import node_subgraph
 from ..ops.unique import relabel_by_reference, unique_first_occurrence
 from ..typing import PADDING_ID
@@ -243,13 +243,15 @@ class NeighborSampler(BaseSampler):
 
         mode = None if neg is None else neg.mode
         amount = 0 if neg is None else int(round(neg.amount))
-        fn = self._get_edges_jit(mode, amount)
+        cdf = None if neg is None else neg.cdf()
+        fn = self._get_edges_jit(mode, amount, cdf is not None)
         g = self.graph
         label = (None if inputs.label is None
                  else jnp.asarray(_pad_ids(inputs.label, q)))
         sorted_indices = (g.sorted_indices if mode is not None else g.indices)
         out = fn(g.indptr, g.indices, g.edge_ids, sorted_indices,
-                 jnp.asarray(src), jnp.asarray(dst), key)
+                 jnp.asarray(src), jnp.asarray(dst),
+                 jnp.zeros((1,), jnp.float32) if cdf is None else cdf, key)
         # Labels are host-side metadata; attach eagerly.
         if mode == "binary":
             meta = out.metadata or {}
@@ -270,28 +272,36 @@ class NeighborSampler(BaseSampler):
         out.metadata["num_pos"] = jnp.asarray(num_pos, jnp.int32)
         return out
 
-    def _get_edges_jit(self, mode: Optional[str], amount: int):
-        k = (mode, amount)
+    def _get_edges_jit(self, mode: Optional[str], amount: int,
+                       weighted: bool = False):
+        k = (mode, amount, weighted)
         if k not in self._sample_edges_jit:
             self._sample_edges_jit[k] = jax.jit(
-                partial(self._sample_edges_impl, mode, amount))
+                partial(self._sample_edges_impl, mode, amount, weighted))
         return self._sample_edges_jit[k]
 
-    def _sample_edges_impl(self, mode, amount, indptr, indices, edge_ids,
-                           sorted_indices, src, dst, key):
+    def _sample_edges_impl(self, mode, amount, weighted, indptr, indices,
+                           edge_ids, sorted_indices, src, dst, cdf, key):
         q = self.batch_size
         kneg, ksample = jax.random.split(key)
         num_nodes = self.graph.num_nodes
+        node_cdf = cdf if weighted else None
 
         if mode == "binary":
+            # Strict rejection (trials + non-strict padding); weighted
+            # draws bias both endpoints through NegativeSampling.weight.
             negs = sample_negative_edges(indptr, sorted_indices, q * amount,
-                                         kneg, num_nodes)
+                                         kneg, num_nodes,
+                                         src_cdf=node_cdf, dst_cdf=node_cdf)
             seed_ids = jnp.concatenate([src, dst, negs.src, negs.dst])
         elif mode == "triplet":
             # amount negative destinations per positive source
             # (cf. neighbor_sampler.py:332-381 triplet reconstruction).
-            neg_dst = jax.random.randint(kneg, (q * amount,), 0, num_nodes,
-                                         dtype=jnp.int32)
+            if weighted:
+                neg_dst = weighted_draw(kneg, cdf, (q * amount,))
+            else:
+                neg_dst = jax.random.randint(kneg, (q * amount,), 0,
+                                             num_nodes, dtype=jnp.int32)
             neg_dst = jnp.where(jnp.repeat(src >= 0, amount), neg_dst,
                                 PADDING_ID)
             seed_ids = jnp.concatenate([src, dst, neg_dst])
