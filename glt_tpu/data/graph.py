@@ -50,6 +50,7 @@ class Graph:
         self._edge_ids: Optional[jnp.ndarray] = None
         self._sorted_indices: Optional[jnp.ndarray] = None
         self._with_sorted_columns = with_sorted_columns
+        self._trivial_edge_ids: Optional[bool] = None
 
     # -- lazy init (cf. data/graph.py:160-188) -----------------------------
     def lazy_init(self) -> None:
@@ -62,7 +63,18 @@ class Graph:
             as_arr = jnp.asarray if self.mode == "DEVICE" else np.asarray
             self._indptr = as_arr(self.topo.indptr.astype(np.int32))
             self._indices = as_arr(self.topo.indices.astype(np.int32))
-            self._edge_ids = as_arr(self.topo.edge_ids.astype(np.int32))
+            host_eids = self.topo.edge_ids.astype(np.int32)
+            self._edge_ids = as_arr(host_eids)
+            # Trivial (positional) edge ids need no gather at sample time:
+            # the sampler can emit CSR positions directly, skipping one
+            # random read over the edge array per hop.
+            self._trivial_edge_ids = bool(
+                host_eids.shape[0] == 0
+                or (host_eids[0] == 0
+                    and host_eids[-1] == host_eids.shape[0] - 1
+                    and np.array_equal(
+                        host_eids,
+                        np.arange(host_eids.shape[0], dtype=np.int32))))
             if self._with_sorted_columns:
                 srt = _sort_columns_within_rows(self.topo.indptr, self.topo.indices)
                 self._sorted_indices = as_arr(srt.astype(np.int32))
@@ -81,6 +93,13 @@ class Graph:
     def edge_ids(self) -> jnp.ndarray:
         self.lazy_init()
         return self._edge_ids
+
+    @property
+    def gather_edge_ids(self) -> Optional[jnp.ndarray]:
+        """Edge-id array for samplers, or None when ids are positional
+        (the sampler then emits CSR positions without a gather)."""
+        self.lazy_init()
+        return None if self._trivial_edge_ids else self._edge_ids
 
     @property
     def sorted_indices(self) -> jnp.ndarray:

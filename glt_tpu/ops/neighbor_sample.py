@@ -32,7 +32,7 @@ from ..typing import PADDING_ID
 class NeighborOutput(NamedTuple):
     """One-hop sampling result (cf. sampler/base.py:301 ``NeighborOutput``)."""
     nbrs: jnp.ndarray       # [B, fanout] neighbor global ids, -1 padded
-    eids: jnp.ndarray       # [B, fanout] global edge ids, -1 padded
+    eids: Optional[jnp.ndarray]  # [B, fanout] global edge ids, -1 padded
     mask: jnp.ndarray       # [B, fanout] bool validity
 
 
@@ -54,6 +54,7 @@ def sample_neighbors(
     key: jax.Array,
     edge_ids: Optional[jnp.ndarray] = None,
     with_replacement: bool = False,
+    with_edge: bool = True,
 ) -> NeighborOutput:
     """Sample up to ``fanout`` neighbors per seed from a CSR graph.
 
@@ -68,6 +69,10 @@ def sample_neighbors(
         matching the reference's implicit edge ids.
       with_replacement: if True, draw i.i.d. uniform neighbors instead of a
         uniform subset.
+      with_edge: when False, skip edge-id materialisation entirely
+        (``eids`` is None) — saves one random gather over the edge array
+        per hop, the dominant cost at wide frontiers (the reference's
+        ``Sample`` vs ``SampleWithEdge`` split, random_sampler.cu:267,310).
 
     Returns:
       :class:`NeighborOutput` with static ``[B, fanout]`` arrays.  Rows with
@@ -108,7 +113,9 @@ def sample_neighbors(
 
     flat = start[:, None] + jnp.where(mask, pos, 0)
     nbrs = jnp.where(mask, indices[flat], PADDING_ID).astype(jnp.int32)
-    if edge_ids is None:
+    if not with_edge:
+        eids = None
+    elif edge_ids is None:
         eids = jnp.where(mask, flat, PADDING_ID).astype(jnp.int32)
     else:
         eids = jnp.where(mask, edge_ids[flat], PADDING_ID).astype(jnp.int32)

@@ -84,6 +84,77 @@ def unique_first_occurrence(ids: jnp.ndarray) -> UniqueResult:
     return UniqueResult(uniques, inverse, count)
 
 
+class DenseInduceState(NamedTuple):
+    """Carry of the dense (scatter-based) incremental inducer.
+
+    ``seen`` is a ``[num_nodes + 2]`` int32 map: 0 = unseen, else
+    ``local_id + 1``.  Slot ``N`` absorbs padding *reads* (always 0);
+    slot ``N + 1`` absorbs dump *writes*.  ``node_buf`` is the cumulative
+    ``[capacity + 1]`` unique-node list (-1 padded; last slot is the write
+    dump), ``count`` the number of valid uniques.
+    """
+    seen: jnp.ndarray
+    node_buf: jnp.ndarray
+    count: jnp.ndarray
+
+
+def dense_induce_init(num_nodes: int, capacity: int) -> DenseInduceState:
+    """Fresh per-batch state (the analog of ``Inducer::Reset``,
+    csrc/cpu/inducer.cc; allocating zeros is a ~4B/node memset)."""
+    return DenseInduceState(
+        seen=jnp.zeros((num_nodes + 2,), jnp.int32),
+        node_buf=jnp.full((capacity + 1,), -1, jnp.int32),
+        count=jnp.zeros((), jnp.int32),
+    )
+
+
+def dense_induce(state: DenseInduceState, cand: jnp.ndarray
+                 ) -> tuple:
+    """Insert ``cand`` (negative = padding) into the cumulative unique
+    list; return ``(state, local)`` where ``local[i]`` is the compact
+    index of ``cand[i]`` (-1 for padding).
+
+    This is the hash-table inducer's contract
+    (``CUDAInducer::InduceNext``, csrc/cuda/inducer.cu:95) implemented
+    with dense scatters instead of sorts: on TPU, an O(N) id->local map
+    plus scatter-min first-occurrence detection beats the O(M log^2 M)
+    bitonic argsorts of :func:`unique_first_occurrence` by ~an order of
+    magnitude at frontier widths >= 100k.  New nodes receive consecutive
+    local ids in first-occurrence order, so per-hop frontier slices of
+    ``node_buf`` are exactly the newly discovered nodes, and seeds placed
+    first keep ``node_buf[:batch] == seeds``.
+    """
+    seen, node_buf, count = state
+    n2 = seen.shape[0]
+    n = n2 - 2
+    m = cand.shape[0]
+    cand = cand.astype(jnp.int32)
+    valid = cand >= 0
+    safe = jnp.where(valid, cand, n)                     # padding reads slot n
+    pos = jnp.arange(m, dtype=jnp.int32)
+
+    existing = seen[safe]                                # 0 = unseen
+    unseen = valid & (existing == 0)
+    # First occurrence of each unseen id within cand: scatter-min of pos.
+    firstpos = (
+        jnp.full((n2,), _INT32_MAX, jnp.int32)
+        .at[jnp.where(unseen, safe, n + 1)]
+        .min(jnp.where(unseen, pos, _INT32_MAX))
+    )
+    is_first = unseen & (firstpos[safe] == pos)
+    local_new = count + jnp.cumsum(is_first.astype(jnp.int32)) - 1
+    # Ids are unique among is_first slots, so this scatter has no
+    # colliding meaningful writes (dump slot n+1 absorbs the rest).
+    seen = seen.at[jnp.where(is_first, safe, n + 1)].set(
+        jnp.where(is_first, local_new + 1, 0))
+    local = jnp.where(valid, seen[safe] - 1, -1)
+    dump = node_buf.shape[0] - 1
+    node_buf = node_buf.at[jnp.where(is_first, local_new, dump)].set(
+        jnp.where(is_first, cand, -1))
+    count = count + jnp.sum(is_first.astype(jnp.int32))
+    return DenseInduceState(seen, node_buf, count), local
+
+
 def relabel_by_reference(reference_ids: jnp.ndarray, query_ids: jnp.ndarray) -> jnp.ndarray:
     """Map each ``query_id`` to its position in ``reference_ids``.
 

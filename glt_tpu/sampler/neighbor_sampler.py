@@ -39,7 +39,12 @@ from ..data.graph import Graph
 from ..ops.neighbor_sample import sample_neighbors
 from ..ops.negative_sample import sample_negative_edges, weighted_draw
 from ..ops.subgraph import node_subgraph
-from ..ops.unique import relabel_by_reference, unique_first_occurrence
+from ..ops.unique import (
+    dense_induce,
+    dense_induce_init,
+    relabel_by_reference,
+    unique_first_occurrence,
+)
 from ..typing import PADDING_ID
 from .base import (
     BaseSampler,
@@ -91,6 +96,9 @@ class NeighborSampler(BaseSampler):
       seed: base PRNG seed; each ``sample_from_nodes`` call advances a
         counter so batches are independent yet reproducible (the analog of
         the curand Philox stream setup, random_sampler.cu:71-73).
+      dedup: 'dense' (O(N) scatter-map inducer, ~10x faster at wide
+        frontiers), 'sort' (O(M log^2 M) argsort-based, no O(N) state), or
+        'auto' (dense unless the id map would exceed ~1GB).
     """
 
     def __init__(
@@ -101,6 +109,7 @@ class NeighborSampler(BaseSampler):
         frontier_cap: Optional[int] = None,
         with_edge: bool = True,
         seed: int = 0,
+        dedup: str = "auto",
     ):
         self.graph = graph
         self.num_neighbors = list(num_neighbors)
@@ -109,6 +118,12 @@ class NeighborSampler(BaseSampler):
         self.with_edge = with_edge
         self._base_key = jax.random.PRNGKey(seed)
         self._call_count = 0
+
+        if dedup not in ("auto", "dense", "sort"):
+            raise ValueError(f"dedup must be auto|dense|sort, got {dedup!r}")
+        if dedup == "auto":
+            dedup = "dense" if graph.num_nodes * 4 <= (1 << 30) else "sort"
+        self.dedup = dedup
 
         self._widths = hop_widths(self.batch_size, self.num_neighbors,
                                   frontier_cap)
@@ -128,19 +143,32 @@ class NeighborSampler(BaseSampler):
 
     # -- core jitted multi-hop program ------------------------------------
     def _sample_impl(self, indptr, indices, edge_ids, seeds, key):
-        """One fused multi-hop sample. seeds: [batch_size], -1 padded."""
+        """One fused multi-hop sample. seeds: [batch_size], -1 padded.
+
+        Dedup strategy ('dense' default): an O(N) scatter-map inducer
+        (:func:`dense_induce`) replaces per-hop argsorts — on TPU the
+        sorts were ~10x the rest of the pipeline at hop-3 frontier
+        widths.  'sort' keeps the growing-buffer argsort path for graphs
+        too large for the dense id map.
+        """
         fanouts = self.num_neighbors
         widths = self._widths
         cap = self.node_capacity
+        dense = self.dedup == "dense"
 
-        u0 = unique_first_occurrence(seeds)
-        # The unique buffer GROWS hop by hop (static per-hop sizes) instead
-        # of being pre-padded to the final capacity: hop i sorts only
-        # O(nodes discoverable by hop i) keys, cutting total sort work
-        # ~2.6x for [15,10,5]-style fanouts.
-        node_buf = u0.uniques                # [widths[0]], -1 padded
-        count = u0.count                     # valid uniques so far
-        frontier = u0.uniques                # [widths[0]]
+        if dense:
+            state = dense_induce_init(self.graph.num_nodes, cap)
+            state, _ = dense_induce(state, seeds)
+            node_buf = state.node_buf
+            count = state.count
+            frontier = node_buf[: widths[0]]
+        else:
+            u0 = unique_first_occurrence(seeds)
+            # The unique buffer GROWS hop by hop (static per-hop sizes):
+            # hop i sorts only O(nodes discoverable by hop i) keys.
+            node_buf = u0.uniques            # [widths[0]], -1 padded
+            count = u0.count                 # valid uniques so far
+            frontier = u0.uniques            # [widths[0]]
         frontier_start = jnp.zeros((), jnp.int32)
 
         rows, cols, eids, emasks = [], [], [], []
@@ -151,27 +179,36 @@ class NeighborSampler(BaseSampler):
         for i, f in enumerate(fanouts):
             w = widths[i]
             out = sample_neighbors(indptr, indices, frontier, f, keys[i],
-                                   edge_ids=edge_ids)
+                                   edge_ids=edge_ids,
+                                   with_edge=self.with_edge)
             # Seed-side local indices (position of frontier nodes in node_buf).
             src_local = frontier_start + jnp.arange(w, dtype=jnp.int32)
             src_local = jnp.where(frontier >= 0, src_local, PADDING_ID)
 
             # Insert this hop's neighbors into the cumulative unique list;
-            # old uniques provably keep their positions (they occur first).
-            buflen = node_buf.shape[0]
+            # old uniques keep their positions.
             cand = out.nbrs.ravel()                        # [w*f]
-            merged = unique_first_occurrence(jnp.concatenate([node_buf, cand]))
-            node_buf = merged.uniques                      # [buflen + w*f]
-            nbr_local = merged.inverse[buflen:].reshape(w, f)
+            if dense:
+                state, nbr_local = dense_induce(state, cand)
+                node_buf = state.node_buf
+                new_count = state.count
+                nbr_local = nbr_local.reshape(w, f)
+            else:
+                buflen = node_buf.shape[0]
+                merged = unique_first_occurrence(
+                    jnp.concatenate([node_buf, cand]))
+                node_buf = merged.uniques              # [buflen + w*f]
+                new_count = merged.count
+                nbr_local = merged.inverse[buflen:].reshape(w, f)
             nbr_local = jnp.where(out.mask, nbr_local, PADDING_ID)
 
             rows.append(nbr_local.ravel())
             cols.append(jnp.broadcast_to(src_local[:, None], (w, f)).ravel())
-            eids.append(out.eids.ravel())
+            if self.with_edge:
+                eids.append(out.eids.ravel())
             emasks.append(out.mask.ravel())
             edges_per_hop.append(jnp.sum(out.mask.astype(jnp.int32)))
 
-            new_count = merged.count
             if i + 1 < len(fanouts):
                 nw = widths[i + 1]
                 frontier = jax.lax.dynamic_slice(
@@ -183,7 +220,7 @@ class NeighborSampler(BaseSampler):
             count = new_count
             counts_per_hop.append(count)
 
-        # Pad the final buffer to the static capacity.
+        # Pad/trim the final buffer to the static capacity.
         if node_buf.shape[0] < cap:
             node_buf = jnp.concatenate(
                 [node_buf,
@@ -202,7 +239,7 @@ class NeighborSampler(BaseSampler):
             # (neighbor_sampler.py:159-165).
             row=jnp.concatenate(rows),
             col=jnp.concatenate(cols),
-            edge=jnp.concatenate(eids),
+            edge=jnp.concatenate(eids) if self.with_edge else None,
             batch=seeds,
             node_mask=jnp.arange(cap, dtype=jnp.int32) < count,
             edge_mask=jnp.concatenate(emasks),
@@ -217,7 +254,7 @@ class NeighborSampler(BaseSampler):
         if key is None:
             key = self._next_key()
         g = self.graph
-        return self._sample_jit(g.indptr, g.indices, g.edge_ids,
+        return self._sample_jit(g.indptr, g.indices, g.gather_edge_ids,
                                 jnp.asarray(seeds), key)
 
     def sample_one_hop(self, srcs: jnp.ndarray, fanout: int,
@@ -228,7 +265,8 @@ class NeighborSampler(BaseSampler):
             key = self._next_key()
         g = self.graph
         return sample_neighbors(g.indptr, g.indices, srcs, fanout, key,
-                                edge_ids=g.edge_ids)
+                                edge_ids=g.gather_edge_ids,
+                                with_edge=self.with_edge)
 
     # -- link path (cf. neighbor_sampler.py:255 sample_from_edges) ---------
     def sample_from_edges(self, inputs: EdgeSamplerInput,
@@ -249,7 +287,7 @@ class NeighborSampler(BaseSampler):
         label = (None if inputs.label is None
                  else jnp.asarray(_pad_ids(inputs.label, q)))
         sorted_indices = (g.sorted_indices if mode is not None else g.indices)
-        out = fn(g.indptr, g.indices, g.edge_ids, sorted_indices,
+        out = fn(g.indptr, g.indices, g.gather_edge_ids, sorted_indices,
                  jnp.asarray(src), jnp.asarray(dst),
                  jnp.zeros((1,), jnp.float32) if cdf is None else cdf, key)
         # Labels are host-side metadata; attach eagerly.

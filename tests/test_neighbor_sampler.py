@@ -151,3 +151,51 @@ class TestSubgraph:
                     if a in node_set and b in node_set}
         got = {(nodes[r], nodes[c]) for r, c in zip(row, col)}
         assert got == expected
+
+
+class TestDedupStrategies:
+    def test_dense_matches_sort(self):
+        """The dense scatter-map inducer and the argsort-based path are
+        drop-in equivalents: identical nodes, edges, masks, and counts for
+        the same key on a random graph with duplicate-heavy fanout."""
+        from glt_tpu.sampler import NeighborSampler, NodeSamplerInput
+
+        rng = np.random.default_rng(7)
+        n, e = 60, 400
+        topo = CSRTopo(np.stack([rng.integers(0, n, e),
+                                 rng.integers(0, n, e)]), num_nodes=n)
+        g = Graph(topo, mode="HOST")
+        seeds = rng.integers(0, n, 8)
+        key = jax.random.PRNGKey(3)
+        outs = {}
+        for dedup in ("dense", "sort"):
+            s = NeighborSampler(g, [4, 3], batch_size=8, seed=0, dedup=dedup)
+            outs[dedup] = s.sample_from_nodes(NodeSamplerInput(seeds),
+                                              key=key)
+        a, b = outs["dense"], outs["sort"]
+        for field in ("node", "row", "col", "edge", "node_mask",
+                      "edge_mask", "num_sampled_nodes",
+                      "num_sampled_edges"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+                err_msg=field)
+
+    def test_with_edge_false_skips_edge_ids(self):
+        """with_edge=False must produce edge=None (no edge-id gather) with
+        everything else identical to with_edge=True (the reference's
+        Sample vs SampleWithEdge split, random_sampler.cu:267,310)."""
+        from glt_tpu.sampler import NeighborSampler, NodeSamplerInput
+
+        g = Graph(ring_graph(), mode="HOST")
+        key = jax.random.PRNGKey(5)
+        seeds = np.arange(6)
+        outs = {}
+        for we in (True, False):
+            s = NeighborSampler(g, [2, 2], batch_size=6, with_edge=we)
+            outs[we] = s.sample_from_nodes(NodeSamplerInput(seeds), key=key)
+        assert outs[False].edge is None
+        assert outs[True].edge is not None
+        for field in ("node", "row", "col", "node_mask", "edge_mask"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(outs[True], field)),
+                np.asarray(getattr(outs[False], field)), err_msg=field)
