@@ -1,0 +1,95 @@
+"""End-to-end epoch-time benchmarks for the BASELINE.md target configs.
+
+Runs the actual example scripts (the same code a user would run) as
+subprocesses and captures the LAST epoch line (first epochs pay compile),
+emitting one JSON line per config:
+
+  {"metric": "epoch_time:<config>", "value": seconds, "unit": "s",
+   "subgraphs_per_s": ..., "loss": ...}
+
+Configs map to BASELINE.md "Target configs":
+  1. products   — supervised GraphSAGE, NeighborLoader       (config 1)
+  2. ppi        — unsupervised GraphSAGE + negative sampling (config 2)
+  3. seal       — SEAL link prediction, subgraph sampling    (config 3)
+  4. igbh       — hetero R-GAT, HeteroNeighborLoader         (config 4)
+
+Scales are synthetic-data fractions chosen so a run finishes in minutes
+over the axon tunnel; they are recorded in the JSON so numbers are
+comparable across rounds.  Usage:
+
+    python benchmarks/bench_epoch.py [--configs products ppi ...]
+"""
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIGS = {
+    "products": {
+        "cmd": [sys.executable, "examples/train_sage_products.py",
+                "--scale", "0.05", "--epochs", "2"],
+        "scale": 0.05,
+    },
+    "ppi": {
+        "cmd": [sys.executable, "examples/graph_sage_unsup_ppi.py",
+                "--scale", "0.5", "--epochs", "2"],
+        "scale": 0.5,
+    },
+    "seal": {
+        "cmd": [sys.executable, "examples/seal_link_pred.py",
+                "--epochs", "2"],
+        "scale": 1.0,
+    },
+    "igbh": {
+        "cmd": [sys.executable, "examples/rgat_igbh.py",
+                "--scale", "0.1", "--epochs", "2"],
+        "scale": 0.1,
+    },
+}
+
+EPOCH_RE = re.compile(
+    r"epoch (\d+): loss=([\d.naninf-]+)(?: acc=([\d.naninf-]+))?"
+    r" time=([\d.]+)s(?: subgraphs/s=([\d.]+))?")
+
+
+def run_config(name: str, cfg: dict, timeout: float) -> dict:
+    out = {"metric": f"epoch_time:{name}", "unit": "s",
+           "scale": cfg["scale"]}
+    try:
+        proc = subprocess.run(
+            cfg["cmd"], cwd=REPO, capture_output=True, text=True,
+            timeout=timeout)
+    except subprocess.TimeoutExpired:
+        out["error"] = f"timeout after {timeout:.0f}s"
+        return out
+    matches = EPOCH_RE.findall(proc.stdout)
+    if proc.returncode != 0 or not matches:
+        out["error"] = (proc.stderr.strip().splitlines() or ["no output"])[-1]
+        return out
+    _, loss, acc, secs, sg = matches[-1]
+    out["value"] = float(secs)
+    out["loss"] = float(loss)
+    if acc:
+        out["acc"] = float(acc)
+    if sg:
+        out["subgraphs_per_s"] = float(sg)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", nargs="+", default=list(CONFIGS),
+                    choices=list(CONFIGS))
+    ap.add_argument("--timeout", type=float, default=1800.0)
+    args = ap.parse_args()
+    for name in args.configs:
+        print(json.dumps(run_config(name, CONFIGS[name], args.timeout)),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

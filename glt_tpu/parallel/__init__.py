@@ -7,7 +7,9 @@ from .dist_sampler import (
 )
 from .dist_feature import (
     TieredShardedFeature,
+    HostColdStore,
     cold_gather_host,
+    route_cold_requests,
     exchange_gather,
     exchange_gather_hot,
     shard_feature_tiered,
@@ -30,7 +32,9 @@ __all__ = [
     "ShardedGraph",
     "TieredShardedFeature",
     "TieredTrainPipeline",
+    "HostColdStore",
     "cold_gather_host",
+    "route_cold_requests",
     "dist_sample_multi_hop",
     "exchange_gather",
     "exchange_gather_hot",

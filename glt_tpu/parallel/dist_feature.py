@@ -124,14 +124,21 @@ def exchange_gather_hot(
     hot_per_shard: int,
     num_shards: int,
     axis_name: str,
+    staged_resp: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """Hot-tier half of a tiered gather; call inside ``shard_map``.
+    """Tiered gather; call inside ``shard_map``.
 
     Same collective round-trip as :func:`exchange_gather`, but the serving
-    shard only answers requests whose local row sits inside its HBM prefix
-    (``local < hot_per_shard``); cold rows come back as zeros and are
-    filled in by the staged host gather (:func:`cold_gather_host`) via
-    :func:`merge_cold`.
+    shard answers hot requests (``local < hot_per_shard``) from HBM and —
+    when ``staged_resp`` is given — cold requests from the **responder-
+    side staged block**: ``staged_resp[j]`` holds the host-gathered cold
+    row for request slot ``j`` of THIS shard (produced by
+    :func:`route_cold_requests` + :meth:`HostColdStore.serve`).  Because
+    every shard serves only rows it owns, each pod host stages only its
+    own shards' cold rows — the multi-host seam the reference's
+    UnifiedTensor UVA reads provided on a single node
+    (unified_tensor.cu:202-311).  Without ``staged_resp`` cold rows come
+    back as zeros (fill them via the legacy :func:`merge_cold` overlay).
     """
     b = ids.shape[0]
     d = hot_rows.shape[-1]
@@ -146,13 +153,85 @@ def exchange_gather_hot(
     local = requests - my_rank * nodes_per_shard
     ok = (local >= 0) & (local < hot_per_shard) & (requests >= 0)
     got = jnp.take(hot_rows, jnp.where(ok, local, 0), axis=0, mode="clip")
-    got = jnp.where(ok[:, None], got, 0)
+    if staged_resp is None:
+        got = jnp.where(ok[:, None], got, 0)
+    else:
+        # Hot slots from HBM, cold slots from the staged host rows
+        # (disjoint by construction; padding slots are zero either way).
+        got = jnp.where(ok[:, None], got, staged_resp.astype(got.dtype))
 
     resp = lax.all_to_all(
         got.reshape(num_shards, b, d), axis_name, 0, 0,
         tiled=False).reshape(num_shards * b, d)
     out = resp[jnp.clip(routing.slot, 0, num_shards * b - 1)]
     return jnp.where(routing.valid[:, None], out, 0)
+
+
+def route_cold_requests(
+    ids: jnp.ndarray,
+    nodes_per_shard: int,
+    hot_per_shard: int,
+    num_shards: int,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Responder-side cold request slots; call inside ``shard_map``.
+
+    Runs the SAME deterministic bucketing + id all_to_all as
+    :func:`exchange_gather_hot` and returns, for this shard, the local
+    cold row index (``0..c-h``) of every incoming request slot, or -1
+    for hot/foreign/padding slots: ``[num_shards * b]``.  The host then
+    gathers exactly these rows from its local cold store — no host ever
+    touches another host's rows.
+    """
+    b = ids.shape[0]
+    owner = jnp.where(ids >= 0, ids // nodes_per_shard, -1)
+    routing = _bucket_by_owner(ids, owner, num_shards, cap=b)
+    requests = lax.all_to_all(
+        routing.buckets.reshape(num_shards, b), axis_name, 0, 0,
+        tiled=False).reshape(num_shards * b)
+    my_rank = lax.axis_index(axis_name)
+    local = requests - my_rank * nodes_per_shard
+    is_cold = (requests >= 0) & (local >= hot_per_shard) & (
+        local < nodes_per_shard)
+    return jnp.where(is_cold, local - hot_per_shard, -1)
+
+
+class HostColdStore:
+    """Cold rows for the shards one host owns (all shards by default).
+
+    On a multi-host pod each process builds
+    ``HostColdStore(f, shard_ids=<its local shards>)`` and serves only
+    those; the single-process emulation holds every shard.  The staged
+    response for shard ``s`` depends only on shard ``s``'s store, so
+    per-host ``device_put`` placement is naturally correct.
+    """
+
+    def __init__(self, f: TieredShardedFeature, shard_ids=None):
+        self.shard_ids = (tuple(range(f.num_shards)) if shard_ids is None
+                          else tuple(shard_ids))
+        self._blocks = {s: np.asarray(f.cold[s]) for s in self.shard_ids}
+        self.dim = f.cold.shape[-1]
+        self.dtype = f.cold.dtype
+
+    def serve(self, shard: int, cold_req: np.ndarray) -> np.ndarray:
+        """Rows for one shard's request slots.
+
+        Args:
+          cold_req: ``[R]`` local cold row ids from
+            :func:`route_cold_requests` (-1 = not a cold row of ours).
+        Returns ``[R, d]`` with zeros at -1 slots.
+        """
+        if shard not in self._blocks:
+            raise KeyError(
+                f"shard {shard} is not local to this host "
+                f"(local: {self.shard_ids})")
+        blk = self._blocks[shard]
+        cold_req = np.asarray(cold_req)
+        out = np.zeros((cold_req.shape[0], self.dim), self.dtype)
+        sel = cold_req >= 0
+        if blk.shape[0] > 0 and sel.any():
+            out[sel] = blk[cold_req[sel]]
+        return out
 
 
 def cold_mask(ids: jnp.ndarray, nodes_per_shard: int,

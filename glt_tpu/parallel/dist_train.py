@@ -27,10 +27,10 @@ from ..models.train import TrainState, seed_cross_entropy
 from ..typing import PADDING_ID
 from .dist_feature import (
     TieredShardedFeature,
-    cold_gather_host,
+    HostColdStore,
     exchange_gather,
     exchange_gather_hot,
-    merge_cold,
+    route_cold_requests,
 )
 from .dist_sampler import DistNeighborSampler, dist_sample_multi_hop
 from .sharding import ShardedFeature, ShardedGraph
@@ -113,25 +113,27 @@ def make_tiered_train_step(
 ):
     """Build the train half of the tiered two-stage pipeline.
 
-    Returns ``train(state, out, cold_x, key) -> (state, loss, acc)`` where
-    ``out`` is the sample stage's per-shard :class:`SamplerOutput` and
-    ``cold_x`` is the host-gathered ``[S, node_cap, d]`` cold-row block
-    (:func:`~glt_tpu.parallel.dist_feature.cold_gather_host`).  Hot rows
-    ride the in-jit all-to-all; cold rows are overlaid where
-    ``node % c >= hot_per_shard`` — the split the reference's UnifiedTensor
-    makes per-row inside its gather kernel (unified_tensor.cu:48-81).
+    Returns ``train(state, out, staged_resp, key) -> (state, loss, acc)``
+    where ``out`` is the sample stage's per-shard :class:`SamplerOutput`
+    and ``staged_resp`` is the responder-side ``[S, S * node_cap, d]``
+    cold-row block: shard ``s``'s slice holds host-gathered rows for the
+    cold requests ROUTED TO ``s`` (:func:`route_cold_requests` +
+    :meth:`HostColdStore.serve`), so each pod host stages only rows its
+    own shards own.  Hot rows ride the in-jit all-to-all; cold rows join
+    them in the response leg — the per-row HBM/host split the reference's
+    UnifiedTensor makes inside its gather kernel (unified_tensor.cu:48-81).
     """
     gspec = P(axis_name)
 
-    def local_body(hot_rows, labels_blk, out, cold_x, params, key):
-        hot_rows, labels_blk, cold_x = hot_rows[0], labels_blk[0], cold_x[0]
+    def local_body(hot_rows, labels_blk, out, staged_resp, params, key):
+        hot_rows, labels_blk = hot_rows[0], labels_blk[0]
+        staged_resp = staged_resp[0]
         out = jax.tree.map(lambda x: x[0], out)
         key = jax.random.fold_in(key, lax.axis_index(axis_name))
 
-        hot_x = exchange_gather_hot(out.node, hot_rows, f.nodes_per_shard,
-                                    f.hot_per_shard, f.num_shards, axis_name)
-        x = merge_cold(hot_x, cold_x, out.node, f.nodes_per_shard,
-                       f.hot_per_shard)
+        x = exchange_gather_hot(out.node, hot_rows, f.nodes_per_shard,
+                                f.hot_per_shard, f.num_shards, axis_name,
+                                staged_resp=staged_resp)
         y = exchange_gather(out.node, labels_blk[:, None].astype(jnp.int32),
                             g.nodes_per_shard, g.num_shards, axis_name)[:, 0]
         y = jnp.where(out.node >= 0, y, PADDING_ID)
@@ -156,8 +158,8 @@ def make_tiered_train_step(
         check_vma=False)
 
     @jax.jit
-    def train(state: TrainState, out, cold_x, key: jax.Array):
-        loss, acc, grads = shard_fn(f.hot, labels, out, cold_x,
+    def train(state: TrainState, out, staged_resp, key: jax.Array):
+        loss, acc, grads = shard_fn(f.hot, labels, out, staged_resp,
                                     state.params, key)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -181,22 +183,47 @@ class TieredTrainPipeline:
 
     def __init__(self, sampler: DistNeighborSampler,
                  train_step, f: TieredShardedFeature, mesh: Mesh,
-                 axis_name: str = "shard"):
+                 axis_name: str = "shard",
+                 cold_store: Optional[HostColdStore] = None):
         import concurrent.futures
 
         self.sampler = sampler
         self.train_step = train_step
         self.f = f
+        self.cold_store = cold_store or HostColdStore(f)
         self._cold_spec = jax.sharding.NamedSharding(mesh, P(axis_name))
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="glt-cold-stage")
+        gspec = P(axis_name)
+        self._route = jax.jit(jax.shard_map(
+            lambda nodes: route_cold_requests(
+                nodes[0], f.nodes_per_shard, f.hot_per_shard,
+                f.num_shards, axis_name)[None],
+            mesh=mesh, in_specs=(gspec,), out_specs=gspec,
+            check_vma=False))
 
     def _stage_cold_async(self, out):
-        """Submit the host gather for ``out.node``; returns a future."""
+        """Submit the cold staging for ``out.node``; returns a future.
+
+        Route (in-jit id all_to_all) -> per-shard host gather from this
+        host's cold store -> device_put of the responder-side block.  On a
+        pod each process serves only its local shards; here one process
+        serves all of them.
+        """
+        cold_req = self._route(out.node)
+
         def work():
-            nodes = np.asarray(out.node)   # waits on the sample stage only
-            cold = cold_gather_host(self.f, nodes)
-            return jax.device_put(cold, self._cold_spec)
+            req = np.asarray(cold_req)    # waits on the route stage only
+            # Serve only the store's local shards (all of them in the
+            # single-process emulation; on a pod, this host's subset —
+            # remote shards' slices stay zero here and are filled by
+            # their own hosts' device_put).
+            staged = np.zeros(
+                (self.f.num_shards, req.shape[1], self.cold_store.dim),
+                self.cold_store.dtype)
+            for s in self.cold_store.shard_ids:
+                staged[s] = self.cold_store.serve(s, req[s])
+            return jax.device_put(staged, self._cold_spec)
         return self._pool.submit(work)
 
     def run_epoch(self, state: TrainState, seed_batches, key: jax.Array):

@@ -66,11 +66,13 @@ class NegativeSampling:
         self.weight = None if weight is None else np.asarray(weight,
                                                              np.float32)
         if self.weight is not None:
+            if not np.isfinite(self.weight).all():
+                raise ValueError("negative-sampling weight must be finite")
             if (self.weight < 0).any():
                 raise ValueError("negative-sampling weight must be >= 0")
             if float(self.weight.sum()) <= 0.0:
                 # An all-zero weight would make the CDF 0/0 = NaN and every
-                # draw silently collapse to node 0.
+                # draw silently collapse to one node.
                 raise ValueError("negative-sampling weight must have a "
                                  "positive sum")
         self._cdf = None

@@ -170,6 +170,66 @@ class TestTieredFeature:
         np.testing.assert_allclose(np.asarray(tier), np.asarray(full),
                                    rtol=1e-6)
 
+    def test_shard_local_cold_stores_match_full(self, part_dir):
+        """The multi-host seam: responder-side staging built from two
+        half-pod HostColdStores (each holding only its shards' cold rows)
+        equals the single-store staging, and the staged tiered gather
+        equals the fully-HBM gather.  Cf. the capability being replaced:
+        unified_tensor.cu:202-311 (UVA host tier)."""
+        from glt_tpu.parallel import HostColdStore, route_cold_requests
+
+        root, _, _, labels = part_dir
+        ds_full = DistDataset.load(root, hot_ratio=1.0, labels=labels)
+        ds_tier = DistDataset.load(root, hot_ratio=0.25, labels=labels)
+        f_full, f_tier = ds_full.feature, ds_tier.feature
+        mesh = _mesh()
+        c, h = f_tier.nodes_per_shard, f_tier.hot_per_shard
+
+        rng = np.random.default_rng(3)
+        ids = np.full((N_DEV, 16), -1, np.int64)
+        for s in range(N_DEV):
+            ids[s, :12] = ds_tier.translate(rng.choice(N, 12, replace=False))
+        ids_j = jnp.asarray(ids, jnp.int32)
+        gspec = P("shard")
+
+        route = jax.jit(jax.shard_map(
+            lambda nodes: route_cold_requests(nodes[0], c, h, N_DEV,
+                                              "shard")[None],
+            mesh=mesh, in_specs=(gspec,), out_specs=gspec,
+            check_vma=False))
+        req = np.asarray(route(ids_j))
+
+        full_store = HostColdStore(f_tier)
+        half = N_DEV // 2
+        stores = [HostColdStore(f_tier, shard_ids=range(0, half)),
+                  HostColdStore(f_tier, shard_ids=range(half, N_DEV))]
+        staged_full = np.stack([full_store.serve(s, req[s])
+                                for s in range(N_DEV)])
+        staged_halves = np.stack([
+            stores[0 if s < half else 1].serve(s, req[s])
+            for s in range(N_DEV)])
+        np.testing.assert_array_equal(staged_halves, staged_full)
+        assert (staged_halves != 0).any()  # cold rows actually flowed
+        with pytest.raises(KeyError):
+            stores[0].serve(N_DEV - 1, req[-1])
+
+        def tier_body(hot, ids, staged):
+            return exchange_gather_hot(ids[0], hot[0], c, h, N_DEV,
+                                       "shard", staged_resp=staged[0])[None]
+
+        def full_body(rows, ids):
+            return exchange_gather(ids[0], rows[0], c, N_DEV, "shard")[None]
+
+        tier = jax.jit(jax.shard_map(
+            tier_body, mesh=mesh, in_specs=(gspec, gspec, gspec),
+            out_specs=gspec, check_vma=False))(
+                f_tier.hot, ids_j, jnp.asarray(staged_halves))
+        full = jax.jit(jax.shard_map(
+            full_body, mesh=mesh, in_specs=(gspec, gspec), out_specs=gspec,
+            check_vma=False))(f_full.rows, ids_j)
+        np.testing.assert_allclose(np.asarray(tier), np.asarray(full),
+                                   rtol=1e-6)
+
     def test_tiered_pipeline_loss_drops(self, part_dir):
         root, _, _, labels = part_dir
         ds = DistDataset.load(root, hot_ratio=0.25, labels=labels)
@@ -237,14 +297,14 @@ class TestTieredFeature:
         # per step; with overlap most of it must vanish from the wall
         # clock, without overlap it all lands on the critical path.
         delay = max(0.01, 0.6 * t_base / n_steps)
-        real_gather = cold_gather_host
+        real_serve = pipe.cold_store.serve
 
-        def slow_gather(f, nodes):
-            time.sleep(delay)
-            return real_gather(f, nodes)
+        def slow_serve(shard, req):
+            if shard == 0:  # one injected delay per step, not per shard
+                time.sleep(delay)
+            return real_serve(shard, req)
 
-        import glt_tpu.parallel.dist_train as dt
-        monkeypatch.setattr(dt, "cold_gather_host", slow_gather)
+        monkeypatch.setattr(pipe.cold_store, "serve", slow_serve)
         t_delay = timed_epochs(reps, 100)
 
         added = t_delay - t_base
