@@ -58,15 +58,130 @@ class TableDataset(Dataset):
         return ds
 
     @classmethod
+    def from_tables(
+        cls,
+        edge_tables,
+        node_tables,
+        reader_factory=None,
+        graph_mode: str = "DEVICE",
+        split_ratio: float = 1.0,
+        label_from_last_column: bool = False,
+        reader_batch_size: int = 1024,
+        **graph_kwargs,
+    ) -> "TableDataset":
+        """Build a Dataset by draining table readers (cf. the reference's
+        ``TableDataset.load``, data/table_dataset.py:28-148).
+
+        Record formats mirror the reference exactly:
+          * edge tables yield ``(src_id, dst_id)`` records;
+          * node tables yield ``(id, "f1:f2:...:fd")`` records — the
+            colon-separated feature string may be ``str`` or ``bytes``
+            (table_dataset.py:124-135); with ``label_from_last_column``
+            the final component is split off as an integer label.
+
+        ``reader_factory(table_name) -> reader`` must return an object
+        with ``read(batch_size, allow_smaller_final_batch=True)`` that
+        raises ``StopIteration`` (or common_io's OutOfRangeException)
+        when drained, and ``close()`` — the ``common_io.table.TableReader``
+        interface.  Defaults to common_io (PAI platform, gated); pass
+        your own factory anywhere else (see ``ListTableReader`` in
+        tests/test_aux.py for the in-memory shape).
+
+        Single-entry dicts build a homogeneous dataset; multi-entry
+        dicts (keyed by edge type tuple / node type) build hetero.
+        """
+        if reader_factory is None:
+            try:
+                import common_io
+            except ImportError as e:
+                raise ImportError(
+                    "from_tables without reader_factory needs the PAI "
+                    "'common_io' reader; pass reader_factory=... (any "
+                    "object with read()/close()) elsewhere") from e
+            reader_factory = common_io.table.TableReader
+            oor = (StopIteration, common_io.exception.OutOfRangeException)
+        else:
+            try:
+                import common_io
+                oor = (StopIteration,
+                       common_io.exception.OutOfRangeException)
+            except ImportError:
+                oor = (StopIteration,)
+
+        def drain(table):
+            reader = reader_factory(table)
+            records = []
+            try:
+                while True:
+                    try:
+                        got = reader.read(reader_batch_size,
+                                          allow_smaller_final_batch=True)
+                    except oor:
+                        break
+                    if not got:
+                        break
+                    records.extend(got)
+            finally:
+                reader.close()
+            return records
+
+        edge_hetero = len(edge_tables) > 1
+        node_hetero = len(node_tables) > 1
+        if edge_hetero != node_hetero:
+            raise ValueError(
+                f"edge_tables ({len(edge_tables)}) and node_tables "
+                f"({len(node_tables)}) must agree on hetero-ness: a homo "
+                f"graph with per-type features (or vice versa) is not a "
+                f"consistent Dataset")
+        edge_index = {}
+        for e_type, table in edge_tables.items():
+            recs = drain(table)
+            arr = np.stack([
+                np.array([r[0] for r in recs], dtype=np.int64),
+                np.array([r[1] for r in recs], dtype=np.int64)])
+            edge_index[e_type] = arr
+        if not edge_hetero:
+            edge_index = next(iter(edge_index.values()))
+
+        feats, labels = {}, {}
+        for n_type, table in node_tables.items():
+            recs = drain(table)
+            ids = np.array([r[0] for r in recs], dtype=np.int64)
+
+            def parse(field):
+                if isinstance(field, bytes):
+                    field = field.decode()
+                return [float(v) for v in field.split(":")]
+
+            mat = np.asarray([parse(r[1]) for r in recs], np.float32)
+            # Rows are stored BY ID so the graph's raw ids index them
+            # directly; gaps get zero features / -1 labels (the reference
+            # sorts by id and assumes contiguity, table_dataset.py:126 —
+            # scattering by id is the gap-safe generalisation, matching
+            # from_arrays).
+            n_rows = int(ids.max()) + 1 if ids.size else 0
+            full = np.zeros((n_rows, mat.shape[1]), np.float32)
+            full[ids] = mat
+            if label_from_last_column:
+                lab = np.full(n_rows, -1, np.int64)
+                lab[ids] = full[ids][:, -1].astype(np.int64)
+                labels[n_type] = lab
+                full = full[:, :-1]
+            feats[n_type] = full
+        if not node_hetero:
+            feats = next(iter(feats.values()))
+            labels = next(iter(labels.values())) if labels else None
+
+        ds = cls()
+        ds.init_graph(edge_index, graph_mode=graph_mode, **graph_kwargs)
+        ds.init_node_features(feats, split_ratio=split_ratio)
+        if label_from_last_column:
+            ds.init_node_labels(labels)
+        return ds
+
+    @classmethod
     def from_odps(cls, edge_table: str, node_table: str, **kwargs):
-        try:
-            import common_io  # noqa: F401  (PAI platform only)
-        except ImportError as e:
-            raise ImportError(
-                "TableDataset.from_odps requires the PAI 'common_io' "
-                "reader, which is not available in this environment; use "
-                "TableDataset.from_arrays with columns loaded via your own "
-                "reader instead") from e
-        raise NotImplementedError(
-            "ODPS table reading is platform-specific; wire common_io "
-            "readers to from_arrays columns")
+        """Reference-named entry point: homo graph from two ODPS tables
+        via the PAI ``common_io`` reader (gated; see :meth:`from_tables`)."""
+        return cls.from_tables({"edge": edge_table}, {"node": node_table},
+                               **kwargs)

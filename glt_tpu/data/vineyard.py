@@ -1,20 +1,80 @@
-"""Vineyard (GraphScope) store connectors — gated.
+"""Vineyard (GraphScope) store connectors.
 
-Mirrors the reference's optional vineyard integration
-(csrc/cpu/vineyard_utils.cc, built only ``WITH_VINEYARD``): reading a
-graph's CSR and vertex/edge feature columns out of a vineyard object
-store.  The vineyard client libraries are platform infrastructure that is
-not part of this environment; the API surface is kept (same three entry
-points) and gates on the client being importable, converting straight
-into :class:`CSRTopo` / numpy feature blocks when it is.
+Rebuild of the reference's optional vineyard integration
+(``csrc/cpu/vineyard_utils.cc``, built only ``WITH_VINEYARD``): reading a
+property-graph fragment's CSR topology and vertex/edge feature columns
+out of a vineyard object store.
+
+The C++ reference walks an ``ArrowFragment`` — per (v_label, e_label):
+the outgoing offset array (vineyard_utils.cc:55), the adjacency list's
+neighbor vids + edge ids (:70-90), and Arrow property columns reshaped
+into ``[n, k]`` tensors (:100-180).  This module implements the same
+three entry points against a small documented **fragment protocol**
+(:class:`FragmentProtocol`) so the logic is testable without a vineyard
+deployment:
+
+* pass any object implementing the protocol (e.g. :class:`MockFragment`,
+  or a thin adapter over your deployment's fragment class), or
+* pass ``(sock, object_id)`` to :func:`connect_fragment`, which fetches
+  the object through the gated ``vineyard`` client and expects it to
+  implement the protocol (GraphScope python fragments can be wrapped in
+  a few lines — the schema is deployment-specific, exactly why the
+  protocol seam exists).
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from .topology import CSRTopo
+
+
+class FragmentProtocol:
+    """Duck-typed fragment interface (document, not a base class).
+
+    Mirrors the slices of ``vineyard::ArrowFragment`` the reference
+    reads (vineyard_utils.cc:32-247):
+
+    * ``outgoing_offsets(v_label, e_label) -> [n+1] int array`` — CSR
+      indptr for the label pair (``GetOutgoingOffsetArray``).
+    * ``outgoing_indices(v_label, e_label) -> [E] int array`` — neighbor
+      vertex ids (``GetOutgoingAdjList`` neighbors).
+    * ``outgoing_edge_ids(v_label, e_label) -> [E] int array or None``
+      (``edge_id`` per adjacency entry; None when ``has_eid=False``).
+    * ``vertex_columns(v_label) -> Dict[str, np.ndarray]`` — property
+      name -> ``[n]`` or ``[n, k]`` column.
+    * ``edge_columns(e_label) -> Dict[str, np.ndarray]``.
+    """
+
+
+class MockFragment:
+    """In-memory :class:`FragmentProtocol` implementation (tests/dev)."""
+
+    def __init__(self, indptr, indices, edge_ids=None,
+                 vertex_cols: Optional[Dict[str, np.ndarray]] = None,
+                 edge_cols: Optional[Dict[str, np.ndarray]] = None):
+        self._indptr = {(0, 0): np.asarray(indptr)}
+        self._indices = {(0, 0): np.asarray(indices)}
+        self._eids = {(0, 0): None if edge_ids is None
+                      else np.asarray(edge_ids)}
+        self._vcols = {0: dict(vertex_cols or {})}
+        self._ecols = {0: dict(edge_cols or {})}
+
+    def outgoing_offsets(self, v_label, e_label):
+        return self._indptr[(v_label, e_label)]
+
+    def outgoing_indices(self, v_label, e_label):
+        return self._indices[(v_label, e_label)]
+
+    def outgoing_edge_ids(self, v_label, e_label):
+        return self._eids[(v_label, e_label)]
+
+    def vertex_columns(self, v_label):
+        return self._vcols[v_label]
+
+    def edge_columns(self, e_label):
+        return self._ecols[e_label]
 
 
 def _require_vineyard():
@@ -24,31 +84,123 @@ def _require_vineyard():
     except ImportError as e:
         raise ImportError(
             "vineyard support requires the 'vineyard' client package "
-            "(GraphScope deployments); load your graph via Dataset/"
-            "TableDataset.from_arrays instead") from e
+            "(GraphScope deployments); pass a FragmentProtocol object "
+            "directly, or load your graph via Dataset/TableDataset"
+        ) from e
 
 
-def to_csr(sock: str, object_id: int, v_label: int, e_label: int,
-           has_eid: bool = True) -> CSRTopo:
-    """Read one (v_label, e_label) fragment's CSR (cf. vineyard_utils.cc:32)."""
+def connect_fragment(sock: str, object_id):
+    """Fetch a fragment through the vineyard client (gated).
+
+    The returned object must implement :class:`FragmentProtocol`; wrap
+    your deployment's fragment class if it does not.
+    """
     vineyard = _require_vineyard()
     client = vineyard.connect(sock)
-    frag = client.get(object_id)
-    raise NotImplementedError(
-        "wire your fragment's indptr/indices arrays into CSRTopo((indptr, "
-        "indices), layout='CSR'); the fragment schema is deployment-"
-        "specific")
+    frag = client.get_object(object_id)
+    missing = [m for m in ("outgoing_offsets", "outgoing_indices",
+                           "vertex_columns")
+               if not hasattr(frag, m)]
+    if missing:
+        raise TypeError(
+            f"vineyard object {object_id} does not implement the fragment "
+            f"protocol (missing {missing}); wrap it in an adapter exposing "
+            f"FragmentProtocol (see glt_tpu.data.vineyard docstring)")
+    return frag
 
 
-def load_vertex_features(sock: str, object_id: int, v_label: int,
+def _resolve(frag_or_sock, object_id):
+    if isinstance(frag_or_sock, str):
+        return connect_fragment(frag_or_sock, object_id)
+    return frag_or_sock
+
+
+def to_csr(frag_or_sock, object_id=None, v_label: int = 0,
+           e_label: int = 0, has_eid: bool = True) -> CSRTopo:
+    """Read one (v_label, e_label) fragment CSR into a :class:`CSRTopo`
+    (cf. ``ToCSR``, vineyard_utils.cc:32-96).
+
+    Args:
+      frag_or_sock: a :class:`FragmentProtocol` object, or a vineyard IPC
+        socket path (then ``object_id`` is required).
+    """
+    frag = _resolve(frag_or_sock, object_id)
+    indptr = np.asarray(frag.outgoing_offsets(v_label, e_label),
+                        dtype=np.int64)
+    indices = np.asarray(frag.outgoing_indices(v_label, e_label),
+                         dtype=np.int64)
+    tail = int(indptr[-1]) if indptr.ndim == 1 and indptr.size else None
+    if tail is None or tail != indices.shape[0]:
+        raise ValueError(
+            f"fragment CSR is inconsistent: indptr[-1]={tail} but "
+            f"{indices.shape[0]} indices")
+    edge_ids = None
+    if has_eid:
+        edge_ids = frag.outgoing_edge_ids(v_label, e_label)
+        if edge_ids is not None:
+            edge_ids = np.asarray(edge_ids, dtype=np.int64)
+    return CSRTopo((indptr, indices), layout="CSR", edge_ids=edge_ids)
+
+
+def _columns_to_matrix(cols: Dict[str, np.ndarray],
+                       selected: Optional[List[str]]) -> np.ndarray:
+    """Stack selected property columns into a float32 ``[n, d]`` matrix
+    (cf. ``ArrowArray2Tensor`` + the column loop, vineyard_utils.cc:100-180)."""
+    names = list(cols.keys()) if selected is None else list(selected)
+    if not names:
+        raise ValueError("no feature columns selected")
+    blocks = []
+    n = None
+    for name in names:
+        if name not in cols:
+            raise KeyError(f"fragment has no column {name!r}; available: "
+                           f"{sorted(cols)}")
+        col = np.asarray(cols[name], dtype=np.float32)
+        if col.ndim == 1:
+            col = col[:, None]
+        if n is None:
+            n = col.shape[0]
+        elif col.shape[0] != n:
+            raise ValueError(
+                f"column {name!r} has {col.shape[0]} rows, expected {n}")
+        blocks.append(col)
+    return np.concatenate(blocks, axis=1)
+
+
+def load_vertex_features(frag_or_sock, object_id=None, v_label: int = 0,
                          columns: Optional[List[str]] = None) -> np.ndarray:
-    """cf. vineyard_utils.cc:130 ``LoadVertexFeatures``."""
-    _require_vineyard()
-    raise NotImplementedError("see to_csr")
+    """Vertex property columns as ``[n, d]`` float32
+    (cf. ``LoadVertexFeatures``, vineyard_utils.cc:130)."""
+    frag = _resolve(frag_or_sock, object_id)
+    return _columns_to_matrix(frag.vertex_columns(v_label), columns)
 
 
-def load_edge_features(sock: str, object_id: int, e_label: int,
+def load_edge_features(frag_or_sock, object_id=None, e_label: int = 0,
                        columns: Optional[List[str]] = None) -> np.ndarray:
-    """cf. vineyard_utils.cc:189 ``LoadEdgeFeatures``."""
-    _require_vineyard()
-    raise NotImplementedError("see to_csr")
+    """Edge property columns as ``[E, d]`` float32
+    (cf. ``LoadEdgeFeatures``, vineyard_utils.cc:189)."""
+    frag = _resolve(frag_or_sock, object_id)
+    return _columns_to_matrix(frag.edge_columns(e_label), columns)
+
+
+def fragment_to_dataset(frag, v_label: int = 0, e_label: int = 0,
+                        feature_columns: Optional[List[str]] = None,
+                        label_column: Optional[str] = None,
+                        graph_mode: str = "DEVICE", split_ratio: float = 1.0):
+    """Convenience: fragment -> ready-to-sample :class:`Dataset`."""
+    from .dataset import Dataset
+    from .graph import Graph
+
+    topo = to_csr(frag, v_label=v_label, e_label=e_label)
+    ds = Dataset()
+    ds.graph = Graph(topo, mode=graph_mode)
+    vcols = frag.vertex_columns(v_label)
+    feat_cols = feature_columns
+    if feat_cols is None:
+        feat_cols = [c for c in vcols if c != label_column]
+    if feat_cols:
+        ds.init_node_features(_columns_to_matrix(vcols, feat_cols),
+                              split_ratio=split_ratio)
+    if label_column is not None:
+        ds.init_node_labels(np.asarray(vcols[label_column]).ravel())
+    return ds
