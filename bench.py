@@ -3,12 +3,42 @@
 Metric definition follows the reference's ``benchmarks/api/bench_sampler.py``
 (:27-54): multi-hop neighbor sampling with fanout [15, 10, 5], batch 1024,
 on an ogbn-products-scale graph, reporting "Sampled Edges per sec (M)".
-The reference publishes no absolute numbers (BASELINE.md) — ``BASELINE_M``
-below is an *estimate* of the reference's single-A100 result for this exact
-config, used only to populate ``vs_baseline``.
+
+Graph: **power-law** degree sequence (``benchmarks/graph_gen.py``), so both
+kernel branches (Floyd's k-subset for ``deg > fanout``, take-all for
+``deg <= fanout``) and hub rows are exercised — not the uniform
+fixed-degree graph of rounds 1-2.
+
+Baselines (see BASELINE.md "Baseline anchors"):
+  * ``vs_ref_cpu`` — MEASURED: the reference's own CPU sampling engine
+    (``csrc/cpu/random_sampler.cc`` + ``inducer.cc``) compiled from
+    /root/reference and run on this host over the *same* graph and seed
+    batches (``benchmarks/ref_baseline/run_ref_cpu.py``).
+  * ``vs_baseline`` — ESTIMATED single-A100 throughput for the reference's
+    CUDA engine on this metric; derivation in BASELINE.md (launch/sync
+    overhead-bound ceiling analysis, cross-checked against published
+    GPU-sampler numbers). The reference publishes no absolute number.
+
+Timing is reported three ways to separate host dispatch from device time
+(VERDICT r2 weak #2 — the axon tunnel adds dispatch latency):
+  * pipelined  — enqueue all iterations; a device-side running total
+    chains every batch, and ONE host fetch of that scalar at the end is
+    the sync point (headline; matches the async prefetch the training
+    loop actually uses).
+  * dispatch   — per-call time until the async dispatch returns (host+
+    tunnel cost only).
+  * serialized — fetch each batch's edge count to host every iteration
+    (per-batch latency: device step + one tunnel round-trip).
+
+NOTE on sync: ``jax.block_until_ready`` does NOT actually wait under the
+axon tunnel (verified: a 16-chain of 8192^2 matmuls "completed" in 0.11ms
+= 164 PFLOP/s), which is why rounds 1-2 printed 1500-1630 M edges/s — a
+pure host-dispatch-rate artifact, not device throughput.  Every timed
+region here therefore ends in a **host value fetch**, which provably
+waits (the same matmul chain fetch-synced: 184ms = 95 TFLOP/s, physical).
 
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 Run on the real TPU chip (ambient JAX_PLATFORMS=axon); falls back to
 whatever backend is available.  GLT_BENCH_SCALE=small shrinks the graph for
@@ -21,12 +51,15 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "benchmarks"))
 
-# Estimated single-A100 sampled-edges/sec (M) for GLT's CUDA sampler at
-# fanout [15,10,5], batch 1024 on ogbn-products (no published number exists;
-# see BASELINE.md).
-BASELINE_M = 180.0
+# Estimated single-A100 sampled-edges/sec (M) for the reference CUDA engine,
+# fanout [15,10,5] batch 1024 (derivation: BASELINE.md "Baseline anchors").
+BASELINE_A100_M = 600.0
+# Measured on this host (1 CPU thread), reference CPU engine, identical
+# power-law graph + seeds: benchmarks/ref_baseline/run_ref_cpu.py.
+REF_CPU_MEASURED_M = 5.776
 
 FANOUT = [15, 10, 5]
 BATCH = 1024
@@ -34,69 +67,101 @@ WARMUP = 3
 ITERS = 20
 
 
-def build_products_scale_graph(small: bool):
-    """Synthetic graph at ogbn-products scale: 2.45M nodes, avg degree 25.
-
-    Built directly in CSR (fixed degree, uniform neighbors) so construction
-    is O(E) with no sort; the sampler's access pattern (random CSR row
-    reads) matches the real dataset's hot loop.
-    """
-    if small:
-        n, deg = 20_000, 10
-    else:
-        n, deg = 2_449_029, 25
-    rng = np.random.default_rng(0)
-    indptr = (np.arange(n + 1, dtype=np.int64) * deg).astype(np.int32)
-    indices = rng.integers(0, n, n * deg, dtype=np.int32)
-    return n, indptr, indices
-
-
 def main():
     small = os.environ.get("GLT_BENCH_SCALE") == "small"
-    import jax
-    import jax.numpy as jnp
+    import contextlib
 
-    from glt_tpu.sampler.neighbor_sampler import NeighborSampler
-    from glt_tpu.sampler.base import NodeSamplerInput
+    import jax
+
     from glt_tpu.data.graph import Graph
     from glt_tpu.data.topology import CSRTopo
+    from glt_tpu.sampler.base import NodeSamplerInput
+    from glt_tpu.sampler.neighbor_sampler import NeighborSampler
+    from glt_tpu.utils import profile
+    from graph_gen import build_graph, seed_batches
 
-    n, indptr, indices = build_products_scale_graph(small)
+    n, indptr, indices = build_graph(small)
 
     # Bypass CSRTopo's COO round-trip: install CSR arrays directly.
     topo = CSRTopo.__new__(CSRTopo)
-    topo._indptr = indptr
-    topo._indices = indices
+    topo._indptr = indptr.astype(np.int32)
+    topo._indices = indices.astype(np.int32)
     topo._edge_ids = np.arange(indices.shape[0], dtype=np.int32)
     topo._edge_weights = None
     graph = Graph(topo, mode="DEVICE")
 
-    sampler = NeighborSampler(graph, FANOUT, batch_size=BATCH, seed=0)
-    rng = np.random.default_rng(1)
-    seed_batches = [rng.integers(0, n, BATCH, dtype=np.int64)
-                    for _ in range(WARMUP + ITERS)]
+    import jax.numpy as jnp
 
-    outs = []
+    # with_edge=False matches the reference bench exactly: its sampler
+    # default is with_edge=False (neighbor_sampler.py:44) and
+    # bench_sampler.py uses the default — edge ids are never gathered.
+    sampler = NeighborSampler(graph, FANOUT, batch_size=BATCH, seed=0,
+                              with_edge=False)
+    # Pre-stage seed batches in HBM (the reference's pinned-memory
+    # DataLoader + .to(device) prefetch).
+    batches = [jnp.asarray(b.astype(np.int32))
+               for b in seed_batches(n, BATCH, WARMUP + ITERS)]
+
+    # Device-side running total: chains a data dependency through every
+    # batch so one final host fetch waits for ALL of them (see module
+    # docstring — block_until_ready does not wait under the tunnel).
+    acc_edges = jax.jit(lambda tot, nse: tot + nse.sum())
+
+    total = jnp.zeros((), jnp.int32)
     for i in range(WARMUP):
-        out = sampler.sample_from_nodes(NodeSamplerInput(seed_batches[i]))
-        jax.block_until_ready(out.num_sampled_edges)
+        out = sampler.sample_from_nodes(NodeSamplerInput(batches[i]))
+        total = acc_edges(total, out.num_sampled_edges)
+    int(total)  # sync
 
+    # --- pipelined (headline): enqueue everything, one fetch at the end.
+    # GLT_PROFILE_DIR captures a jax profiler trace of this region.
+    prof_dir = os.environ.get("GLT_PROFILE_DIR")
+    ctx = profile.trace(prof_dir) if prof_dir else contextlib.nullcontext()
+    meter = profile.ThroughputMeter()
+    with ctx, meter.measure():
+        total = jnp.zeros((), jnp.int32)
+        dispatch_s = 0.0
+        t0 = time.perf_counter()
+        for i in range(ITERS):
+            td = time.perf_counter()
+            with profile.annotate("sample_batch"):
+                out = sampler.sample_from_nodes(
+                    NodeSamplerInput(batches[WARMUP + i]))
+            dispatch_s += time.perf_counter() - td
+            total = acc_edges(total, out.num_sampled_edges)
+        total_edges = float(int(total))  # host fetch = true sync
+        pipelined_s = time.perf_counter() - t0
+        meter.add(edges=total_edges, batches=ITERS)
+
+    # --- serialized: per-batch latency (device + tunnel round-trip). ---
     t0 = time.perf_counter()
     for i in range(ITERS):
-        out = sampler.sample_from_nodes(
-            NodeSamplerInput(seed_batches[WARMUP + i]))
-        outs.append(out.num_sampled_edges)
-    jax.block_until_ready(outs)
-    dt = time.perf_counter() - t0
+        out = sampler.sample_from_nodes(NodeSamplerInput(batches[WARMUP + i]))
+        np.asarray(out.num_sampled_edges)  # per-batch fetch = true sync
+    serialized_s = time.perf_counter() - t0
 
-    total_edges = float(sum(int(np.asarray(o).sum()) for o in outs))
-    edges_per_sec_m = total_edges / dt / 1e6
+    # The meter saw the same region as pipelined_s; it is the JSON's
+    # source of truth for the headline rate.
+    edges_per_sec_m = meter.rate("edges") / 1e6
+
+    # Achieved-bandwidth fraction — the MFU analog for this memory-bound
+    # workload: each sampled edge costs >= one 4B random neighbor read;
+    # dedup adds ~3 reads + 2 writes of 4B per candidate over the id map.
+    est_traffic_gb_s = edges_per_sec_m * 1e6 * (4 + 20) / 1e9
+    v5e_hbm = 819.0
 
     print(json.dumps({
         "metric": "neighbor_sampling_throughput_f15_10_5_b1024",
         "value": round(edges_per_sec_m, 3),
         "unit": "M sampled edges/s",
-        "vs_baseline": round(edges_per_sec_m / BASELINE_M, 4),
+        "vs_baseline": round(edges_per_sec_m / BASELINE_A100_M, 4),
+        "vs_ref_cpu": round(edges_per_sec_m / REF_CPU_MEASURED_M, 2),
+        "graph": "power-law avg-deg-25 products-scale",
+        "dispatch_ms_per_batch": round(dispatch_s / ITERS * 1e3, 3),
+        "serialized_ms_per_batch": round(serialized_s / ITERS * 1e3, 3),
+        "pipelined_ms_per_batch": round(pipelined_s / ITERS * 1e3, 3),
+        "est_hbm_traffic_gb_s": round(est_traffic_gb_s, 2),
+        "est_hbm_fraction": round(est_traffic_gb_s / v5e_hbm, 4),
     }))
 
 

@@ -1,0 +1,63 @@
+// Baseline-measurement driver: runs the REFERENCE's CPU sampling path
+// (CPURandomSampler + CPUInducer, compiled unmodified from
+// /root/reference) over a caller-provided CSR graph, mirroring the
+// multi-hop loop of the reference's NeighborSampler._sample_from_nodes
+// (python/sampler/neighbor_sampler.py:155-190) and the metric of
+// benchmarks/api/bench_sampler.py:27-54 ("Sampled Edges per sec").
+//
+// This file is OUR code; the reference sources are pulled in by include
+// path at build time (see run_ref_cpu.py) and are never copied into this
+// repository.
+#include <torch/extension.h>
+
+#include <chrono>
+#include <tuple>
+#include <vector>
+
+#include "graphlearn_torch/csrc/cpu/inducer.h"
+#include "graphlearn_torch/csrc/cpu/random_sampler.h"
+
+namespace {
+
+std::tuple<int64_t, double> bench_sample_from_nodes(
+    torch::Tensor indptr, torch::Tensor indices, torch::Tensor seeds,
+    std::vector<int64_t> fanouts, int64_t batch_size) {
+  TORCH_CHECK(indptr.dtype() == torch::kInt64);
+  TORCH_CHECK(indices.dtype() == torch::kInt64);
+  TORCH_CHECK(seeds.dtype() == torch::kInt64);
+  const int64_t row_count = indptr.size(0) - 1;
+  graphlearn_torch::Graph graph(
+      indptr.data_ptr<int64_t>(), indices.data_ptr<int64_t>(),
+      /*edge_id=*/nullptr, /*edge_weight=*/nullptr, row_count,
+      indices.size(0), row_count);
+  graphlearn_torch::CPURandomSampler sampler(&graph);
+  graphlearn_torch::CPUInducer inducer(static_cast<int32_t>(row_count));
+
+  int64_t total_edges = 0;
+  const auto t0 = std::chrono::steady_clock::now();
+  for (int64_t off = 0; off + batch_size <= seeds.size(0);
+       off += batch_size) {
+    auto batch = seeds.slice(0, off, off + batch_size);
+    auto srcs = inducer.InitNode(batch);
+    for (int64_t fanout : fanouts) {
+      auto [nbrs, nbrs_num] =
+          sampler.Sample(srcs, static_cast<int32_t>(fanout));
+      auto [nodes, rows, cols] = inducer.InduceNext(srcs, nbrs, nbrs_num);
+      total_edges += rows.size(0);
+      srcs = nodes;
+    }
+    inducer.Reset();
+  }
+  const double dt =
+      std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+          .count();
+  return {total_edges, dt};
+}
+
+}  // namespace
+
+PYBIND11_MODULE(TORCH_EXTENSION_NAME, m) {
+  m.def("bench_sample_from_nodes", &bench_sample_from_nodes,
+        "Run the reference CPU sampler+inducer multi-hop loop; returns "
+        "(total_sampled_edges, seconds).");
+}

@@ -1,0 +1,87 @@
+"""Measure the reference's CPU sampling throughput on this host.
+
+Compiles the reference's own ``csrc/cpu/random_sampler.cc`` +
+``csrc/cpu/inducer.cc`` (read in place from ``/root/reference``; nothing
+is copied into this repo) behind our driver ``bench_ref_cpu.cc``, then
+runs the reference's sampled-edges/sec metric
+(``benchmarks/api/bench_sampler.py:27-54``) over the SAME synthetic
+power-law graph and seed batches as ``bench.py``.
+
+This provides the *measured* baseline VERDICT r1/r2 asked for: the
+reference's CPU engine, same host, same topology, same metric.  (The
+reference's CUDA engine needs an NVIDIA GPU, which this environment does
+not have; the A100 estimate in BASELINE.md is documented arithmetic.)
+
+Prints one JSON line: {"metric": ..., "value": M_edges_per_sec, ...}.
+"""
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+REFERENCE_ROOT = "/root/reference"
+
+FANOUT = [15, 10, 5]
+BATCH = 1024
+ITERS = 20
+WARMUP = 3
+
+
+def build_module():
+    from torch.utils.cpp_extension import load
+
+    build_dir = os.path.join(REPO, ".torch_ext", "ref_cpu_bench")
+    os.makedirs(build_dir, exist_ok=True)
+    here = os.path.dirname(os.path.abspath(__file__))
+    return load(
+        name="glt_ref_cpu_bench",
+        sources=[
+            os.path.join(here, "bench_ref_cpu.cc"),
+            os.path.join(REFERENCE_ROOT,
+                         "graphlearn_torch/csrc/cpu/random_sampler.cc"),
+            os.path.join(REFERENCE_ROOT,
+                         "graphlearn_torch/csrc/cpu/inducer.cc"),
+        ],
+        extra_include_paths=[REFERENCE_ROOT],
+        extra_cflags=["-O3", "-std=gnu++17"],
+        build_directory=build_dir,
+        verbose=False,
+    )
+
+
+def main():
+    import numpy as np
+    import torch
+
+    from graph_gen import build_graph, seed_batches
+
+    small = os.environ.get("GLT_BENCH_SCALE") == "small"
+    mod = build_module()
+
+    n, indptr, indices = build_graph(small)
+    batches = seed_batches(n, BATCH, WARMUP + ITERS)
+    indptr_t = torch.from_numpy(indptr)
+    indices_t = torch.from_numpy(indices)
+
+    warm = torch.from_numpy(np.concatenate(batches[:WARMUP]))
+    mod.bench_sample_from_nodes(indptr_t, indices_t, warm, FANOUT, BATCH)
+
+    seeds = torch.from_numpy(np.concatenate(batches[WARMUP:]))
+    edges, secs = mod.bench_sample_from_nodes(
+        indptr_t, indices_t, seeds, FANOUT, BATCH)
+
+    print(json.dumps({
+        "metric": "reference_cpu_sampling_throughput_f15_10_5_b1024",
+        "value": round(edges / secs / 1e6, 3),
+        "unit": "M sampled edges/s",
+        "threads": torch.get_num_threads(),
+        "edges": int(edges),
+        "seconds": round(secs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -86,7 +86,9 @@ def main():
             state, loss, acc = step(state, jnp.asarray(seeds),
                                     jax.random.PRNGKey(epoch * 1000 + it))
             losses.append(loss)
-        jax.block_until_ready(losses[-1])
+        # device_get is a true sync; block_until_ready does not
+        # wait under the axon tunnel (see bench.py docstring).
+        jax.device_get(losses[-1])
         dt = time.perf_counter() - t0
         print(f"epoch {epoch}: loss={float(np.mean(jax.device_get(losses))):.4f} "
               f"time={dt:.2f}s "

@@ -69,7 +69,9 @@ def main():
             params, opt_state, loss, acc = step(params, opt_state, batch)
             losses.append(loss)
             accs.append(acc)
-        jax.block_until_ready(losses[-1])
+        # device_get is a true sync; block_until_ready does not
+        # wait under the axon tunnel (see bench.py docstring).
+        jax.device_get(losses[-1])
         print(f"epoch {epoch}: loss={float(np.mean(jax.device_get(losses))):.4f} "
               f"acc={float(np.mean(jax.device_get(accs))):.4f} "
               f"time={time.perf_counter() - t0:.2f}s")

@@ -85,7 +85,9 @@ def main():
             params, w, opt_state, loss = step(
                 params, w, opt_state, batch, jnp.asarray(labels[sel]))
             losses.append(loss)
-        jax.block_until_ready(losses[-1])
+        # device_get is a true sync; block_until_ready does not
+        # wait under the axon tunnel (see bench.py docstring).
+        jax.device_get(losses[-1])
         print(f"epoch {epoch}: loss={float(np.mean(jax.device_get(losses))):.4f} "
               f"time={time.perf_counter() - t0:.2f}s")
 

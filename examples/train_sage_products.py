@@ -52,7 +52,9 @@ def main():
             losses.append(loss)
             accs.append(acc)
             n_batches += 1
-        jax.block_until_ready(losses[-1])
+        # device_get is a true sync; block_until_ready does not
+        # wait under the axon tunnel (see bench.py docstring).
+        jax.device_get(losses[-1])
         dt = time.perf_counter() - t0
         print(f"epoch {epoch}: loss={float(np.mean(jax.device_get(losses))):.4f} "
               f"acc={float(np.mean(jax.device_get(accs))):.4f} "

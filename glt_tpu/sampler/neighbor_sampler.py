@@ -250,12 +250,20 @@ class NeighborSampler(BaseSampler):
     # -- public API (cf. sampler/neighbor_sampler.py:138) ------------------
     def sample_from_nodes(self, inputs: NodeSamplerInput,
                           key: Optional[jax.Array] = None) -> SamplerOutput:
-        seeds = _pad_ids(np.asarray(inputs.node), self.batch_size)
+        ids = inputs.node
+        if (isinstance(ids, jax.Array)
+                and ids.shape == (self.batch_size,)):
+            # Pre-staged device seeds (already padded): skip the host
+            # round-trip — prefetching loaders ship seed batches to HBM
+            # ahead of time (the reference's pin_memory + .to(device)).
+            seeds = ids.astype(jnp.int32)
+        else:
+            seeds = jnp.asarray(_pad_ids(np.asarray(ids), self.batch_size))
         if key is None:
             key = self._next_key()
         g = self.graph
         return self._sample_jit(g.indptr, g.indices, g.gather_edge_ids,
-                                jnp.asarray(seeds), key)
+                                seeds, key)
 
     def sample_one_hop(self, srcs: jnp.ndarray, fanout: int,
                        key: Optional[jax.Array] = None):
