@@ -49,6 +49,16 @@ CONFIGS = {
                 "--scale", "0.1", "--epochs", "2"],
         "scale": 0.1,
     },
+    # Config 5 (papers100M distributed) on the 8-virtual-device CPU mesh:
+    # exercises the full partition -> DistDataset.load -> tiered-pipeline
+    # path; wall-clock here characterises the code path, not TPU speed.
+    "papers100m_cpu8": {
+        "cmd": [sys.executable, "examples/dist_train_papers100m.py",
+                "--devices", "8", "--scale", "2e-5", "--epochs", "2"],
+        "scale": 2e-5,
+        "env": {"JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+    },
 }
 
 EPOCH_RE = re.compile(
@@ -59,10 +69,13 @@ EPOCH_RE = re.compile(
 def run_config(name: str, cfg: dict, timeout: float) -> dict:
     out = {"metric": f"epoch_time:{name}", "unit": "s",
            "scale": cfg["scale"]}
+    env = None
+    if cfg.get("env"):
+        env = dict(os.environ, **cfg["env"])
     try:
         proc = subprocess.run(
             cfg["cmd"], cwd=REPO, capture_output=True, text=True,
-            timeout=timeout)
+            timeout=timeout, env=env)
     except subprocess.TimeoutExpired:
         out["error"] = f"timeout after {timeout:.0f}s"
         return out

@@ -3,6 +3,14 @@ from .dist_options import (
     MpSamplingWorkerOptions,
     RemoteSamplingWorkerOptions,
 )
+from .dist_context import (
+    DistContext,
+    DistRole,
+    get_context,
+    init_client_context,
+    init_server_context,
+    init_worker_group,
+)
 from .dist_dataset import DistDataset
 from .dist_loader import (
     DistLinkNeighborLoader,
@@ -13,7 +21,13 @@ from .sample_message import batch_to_message, message_to_batch
 
 __all__ = [
     "CollocatedSamplingWorkerOptions",
+    "DistContext",
     "DistDataset",
+    "DistRole",
+    "get_context",
+    "init_client_context",
+    "init_server_context",
+    "init_worker_group",
     "DistLinkNeighborLoader",
     "DistNeighborLoader",
     "DistSubGraphLoader",

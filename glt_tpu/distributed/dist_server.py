@@ -192,10 +192,22 @@ class DistServer:
     """Args mirror init_server (dist_server.py:158-190)."""
 
     def __init__(self, dataset, host: str = "127.0.0.1", port: int = 0,
-                 dataset_builder=None, builder_args: tuple = ()):
+                 dataset_builder=None, builder_args: tuple = (),
+                 num_servers: int = 1, server_rank: int = 0,
+                 num_clients: int = 0):
+        from .dist_context import DistContext, DistRole, _set_default
+
         self.dataset = dataset
         self._dataset_builder = dataset_builder
         self._builder_args = builder_args
+        # The server's own topology record; installed as the process
+        # context only when none exists (several roles can share one
+        # process in the single-host test topology — call
+        # init_server_context explicitly to claim the global).
+        self.context = DistContext(
+            DistRole.SERVER, "_default_server", num_servers, server_rank,
+            num_servers + max(num_clients, 0), server_rank)
+        _set_default(self.context)
         self._producers: Dict[int, _Producer] = {}
         self._next_id = 0
         self._lock = threading.Lock()
@@ -214,7 +226,9 @@ class DistServer:
         op = req["op"]
         if op == "get_dataset_meta":
             g = self.dataset.get_graph()
-            return {"num_nodes": g.num_nodes, "num_edges": g.num_edges}
+            return {"num_nodes": g.num_nodes, "num_edges": g.num_edges,
+                    "server_rank": self.context.rank,
+                    "num_servers": self.context.world_size}
         if op == "create_sampling_producer":
             # Construct outside the lock: mp-producer setup (process spawn
             # + dataset rebuild) can take seconds and must not stall other
@@ -304,14 +318,19 @@ class DistServer:
 
 
 def init_server(dataset, host: str = "127.0.0.1", port: int = 0,
-                dataset_builder=None, builder_args: tuple = ()
-                ) -> DistServer:
+                dataset_builder=None, builder_args: tuple = (),
+                num_servers: int = 1, server_rank: int = 0,
+                num_clients: int = 0) -> DistServer:
     """Start a sampling server (cf. init_server, dist_server.py:158-190).
 
     Pass a picklable ``dataset_builder`` (+``builder_args``) to enable
     mp producer pools for clients requesting
     ``RemoteSamplingWorkerOptions(num_workers > 0)``.
+    ``num_servers``/``server_rank``/``num_clients`` record the fleet
+    topology in this process's :class:`~.dist_context.DistContext`.
     """
     return DistServer(dataset, host=host, port=port,
                       dataset_builder=dataset_builder,
-                      builder_args=builder_args)
+                      builder_args=builder_args,
+                      num_servers=num_servers, server_rank=server_rank,
+                      num_clients=num_clients)

@@ -22,7 +22,29 @@ def test_meta(server):
     conn = RemoteServerConnection(server.addr)
     meta = conn.request(op="get_dataset_meta")
     assert meta["num_nodes"] == N
+    assert meta["server_rank"] == 0 and meta["num_servers"] == 1
     conn.close()
+
+
+def test_dist_context_roles():
+    """Role/rank/fleet bookkeeping (cf. dist_context.py:20-183)."""
+    from glt_tpu.distributed import (DistRole, get_context,
+                                     init_client_context,
+                                     init_worker_group)
+
+    ctx = init_worker_group(world_size=4, rank=2)
+    assert get_context() is ctx
+    assert ctx.is_worker() and not ctx.is_server()
+    assert ctx.num_servers() == 0 and ctx.num_clients() == 0
+    assert ctx.worker_name == "_default_worker-2"
+
+    ctx = init_client_context(num_clients=2, client_rank=1, num_servers=2)
+    assert ctx.role == DistRole.CLIENT
+    assert ctx.num_servers() == 2 and ctx.num_clients() == 2
+    assert ctx.global_world_size == 4 and ctx.global_rank == 3
+
+    with pytest.raises(ValueError, match="rank"):
+        init_worker_group(world_size=2, rank=2)
 
 
 def test_remote_loader_epochs(server):
@@ -152,7 +174,13 @@ def test_two_servers_two_clients():
     its own server; the union of delivered batches covers every seed
     exactly once, and every batch verifies against the id-determined
     fixture."""
-    servers = [init_server(build_ring_dataset()) for _ in range(2)]
+    servers = [init_server(build_ring_dataset(), num_servers=2,
+                           server_rank=r, num_clients=2)
+               for r in range(2)]
+    assert servers[1].context.is_server()
+    assert servers[1].context.num_servers() == 2
+    assert servers[1].context.num_clients() == 2
+    assert servers[1].context.worker_name == "_default_server-1"
     halves = [np.arange(0, N // 2), np.arange(N // 2, N)]
     loaders = [
         RemoteNeighborLoader(srv.addr, [2, 2], seeds, batch_size=4)
