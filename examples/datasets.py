@@ -18,6 +18,29 @@ from glt_tpu.data import CSRTopo, Dataset
 DATA_ROOT = os.environ.get("GLT_DATA_ROOT", "/root/data")
 
 
+def ensure_cpu_devices(n: int):
+    """Return >= n jax devices, falling back to the virtual CPU pool.
+
+    Dev-box workaround: an ambient TPU plugin may have pinned platform
+    selection at interpreter start, overriding JAX_PLATFORMS=cpu +
+    xla_force_host_platform_device_count; re-point JAX at CPU and reset
+    backends.  Shared by the distributed examples.
+    """
+    import jax
+
+    devices = jax.devices()
+    if len(devices) < n:
+        from jax._src import xla_bridge as _xb
+
+        jax.config.update("jax_platforms", "cpu")
+        if _xb.backends_are_initialized():
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+        devices = jax.devices()
+    return devices
+
+
 def _from_disk(name: str, graph_mode: str):
     root = os.path.join(DATA_ROOT, name)
     if not os.path.isdir(root):

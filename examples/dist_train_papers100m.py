@@ -117,18 +117,9 @@ def main():
     print(f"loaded: {ds.graph.num_shards} shards x "
           f"{ds.relabel.nodes_per_shard} nodes, hot rows/shard = {hot_desc}")
 
-    devices = jax.devices()
-    if len(devices) < args.devices:
-        # The ambient axon TPU plugin overrides platform selection at
-        # interpreter start; fall back to the virtual CPU device pool.
-        from jax._src import xla_bridge as _xb
+    from examples.datasets import ensure_cpu_devices
 
-        jax.config.update("jax_platforms", "cpu")
-        if _xb.backends_are_initialized():
-            from jax.extend.backend import clear_backends
-
-            clear_backends()
-        devices = jax.devices()
+    devices = ensure_cpu_devices(args.devices)
     if len(devices) < args.devices:
         raise SystemExit(f"need {args.devices} devices, have {len(devices)}")
     devices = devices[: args.devices]

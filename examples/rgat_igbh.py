@@ -40,19 +40,10 @@ def run_distributed(args):
         shard_hetero_graph,
     )
 
+    from examples.datasets import ensure_cpu_devices
+
     n_dev = args.distributed
-    devices = jax.devices()
-    if len(devices) < n_dev:
-        # The ambient axon TPU plugin may have overridden platform
-        # selection; fall back to the virtual CPU device pool.
-        from jax._src import xla_bridge as _xb
-
-        jax.config.update("jax_platforms", "cpu")
-        if _xb.backends_are_initialized():
-            from jax.extend.backend import clear_backends
-
-            clear_backends()
-        devices = jax.devices()
+    devices = ensure_cpu_devices(n_dev)
     if len(devices) < n_dev:
         raise RuntimeError(
             f"need {n_dev} devices, found {len(devices)}; set "

@@ -108,7 +108,8 @@ class Feature:
             idx = jnp.where(valid, ids, 0)
             if self._id2index is not None:
                 idx = self._id2index[idx]
-            # Pallas DMA gather on TPU for wide rows; XLA gather otherwise.
+            # XLA gather (measured 2x the Pallas DMA kernel; see
+            # ops/gather_pallas.py docstring).
             rows = gather_rows(self._hot, idx)
             return jnp.where(valid[:, None], rows, 0)
 

@@ -115,13 +115,22 @@ def init_worker_group(world_size: int = 1, rank: int = 0,
                             world_size, rank))
 
 
+def make_server_context(num_servers: int, server_rank: int,
+                        num_clients: int = 0,
+                        group_name: str = "_default_server") -> DistContext:
+    """Build (without installing) a SERVER context; servers take global
+    ranks [0, num_servers), clients follow — the reference's convention."""
+    return DistContext(
+        DistRole.SERVER, group_name, num_servers, server_rank,
+        num_servers + max(num_clients, 0), server_rank)
+
+
 def init_server_context(num_servers: int, server_rank: int,
                         num_clients: int = 0,
                         group_name: str = "_default_server") -> DistContext:
     """Declare this process a sampling server."""
-    return _set(DistContext(
-        DistRole.SERVER, group_name, num_servers, server_rank,
-        num_servers + max(num_clients, 0), server_rank))
+    return _set(make_server_context(num_servers, server_rank, num_clients,
+                                    group_name))
 
 
 def init_client_context(num_clients: int, client_rank: int,

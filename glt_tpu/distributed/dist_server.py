@@ -195,7 +195,7 @@ class DistServer:
                  dataset_builder=None, builder_args: tuple = (),
                  num_servers: int = 1, server_rank: int = 0,
                  num_clients: int = 0):
-        from .dist_context import DistContext, DistRole, _set_default
+        from .dist_context import _set_default, make_server_context
 
         self.dataset = dataset
         self._dataset_builder = dataset_builder
@@ -204,9 +204,8 @@ class DistServer:
         # context only when none exists (several roles can share one
         # process in the single-host test topology — call
         # init_server_context explicitly to claim the global).
-        self.context = DistContext(
-            DistRole.SERVER, "_default_server", num_servers, server_rank,
-            num_servers + max(num_clients, 0), server_rank)
+        self.context = make_server_context(num_servers, server_rank,
+                                           num_clients)
         _set_default(self.context)
         self._producers: Dict[int, _Producer] = {}
         self._next_id = 0

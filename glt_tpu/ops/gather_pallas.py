@@ -8,9 +8,14 @@ list scalar-prefetched into SMEM (so row addresses are known before the
 body runs), overlapping up to ``LAG`` row copies — the DMA-pipelined
 equivalent of the warp-per-row design.
 
-For small rows XLA's fused gather is already excellent; this kernel wins
-when rows are wide (>= ~512B) and the table lives in HBM.  ``gather_rows``
-picks the kernel or ``jnp.take`` automatically; set ``force`` to override.
+**Measured honestly (round 3, device-synced timing), XLA's native gather
+beats this kernel ~2x at 512B rows** (4.6 vs 9.8 ms per 102400-row
+gather on the v5-lite chip): the per-row DMA issue rate, even with
+``_LAG``-deep pipelining, loses to the hardware gather unit.  Round 1's
+"+15%" for this kernel was an artifact of ``block_until_ready`` not
+actually waiting under the axon tunnel (see bench.py).  ``gather_rows``
+therefore defaults to ``jnp.take``; the kernel stays available via
+``force='pallas'`` as the seam for future multi-stream DMA work.
 """
 from __future__ import annotations
 
@@ -89,28 +94,14 @@ def gather_rows_pallas(table: jnp.ndarray, idx: jnp.ndarray,
     )(idx.astype(jnp.int32), table)
 
 
-def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
-        return False
-
-
 def gather_rows(table: jnp.ndarray, idx: jnp.ndarray,
                 force: str = "auto") -> jnp.ndarray:
     """Gather rows, choosing the best implementation.
 
     force: 'auto' | 'pallas' | 'xla'.
     """
-    b, d = idx.shape[0], table.shape[1]
-    use_pallas = (force == "pallas"
-                  or (force == "auto" and _on_tpu()
-                      and d % 128 == 0 and b % _CHUNK == 0
-                      and d * table.dtype.itemsize >= 512))
-    if use_pallas and force != "xla":
-        try:
-            return gather_rows_pallas(table, idx)
-        except Exception:
-            if force == "pallas":
-                raise
+    # 'auto' = XLA take: measured 2x faster than the DMA kernel at 512B
+    # rows with honest device-synced timing (module docstring).
+    if force == "pallas":
+        return gather_rows_pallas(table, idx)
     return jnp.take(table, jnp.clip(idx, 0, table.shape[0] - 1), axis=0)

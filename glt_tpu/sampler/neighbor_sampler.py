@@ -133,6 +133,7 @@ class NeighborSampler(BaseSampler):
             w * f for w, f in zip(self._widths, self.num_neighbors))
 
         self._sample_jit = jax.jit(self._sample_impl)
+        self._sample_many_jit = {}
         self._sample_edges_jit = {}
 
     # -- key management ----------------------------------------------------
@@ -264,6 +265,50 @@ class NeighborSampler(BaseSampler):
         g = self.graph
         return self._sample_jit(g.indptr, g.indices, g.gather_edge_ids,
                                 seeds, key)
+
+    def sample_from_nodes_batched(self, seeds: jnp.ndarray,
+                                  key: Optional[jax.Array] = None
+                                  ) -> SamplerOutput:
+        """Sample ``G`` seed batches in ONE device program.
+
+        ``seeds``: ``[G, batch_size]`` (-1 padded) device or host array.
+        Returns a stacked :class:`SamplerOutput` pytree (leading axis G).
+
+        This is the TPU analog of the reference's per-worker in-flight
+        concurrency (``worker_concurrency`` <= 32 async batches,
+        dist_options.py / event_loop.py): a ``lax.scan`` chains G
+        independent batches inside one XLA program, amortising host
+        dispatch (one call instead of G).  Measured device time per batch
+        is ~parity with the single-batch path at batch 1024 (device work
+        dominates); the win appears when dispatch is the constraint —
+        many small batches, or busy host threads.  The scan keeps
+        scatters unbatched: a vmap formulation batches the dense-inducer
+        scatters and is ~60x slower.
+        """
+        seeds = jnp.asarray(seeds, jnp.int32)
+        if seeds.ndim != 2 or seeds.shape[1] != self.batch_size:
+            raise ValueError(
+                f"expected [G, {self.batch_size}] seeds, got {seeds.shape}")
+        g = int(seeds.shape[0])
+        if key is None:
+            key = self._next_key()
+        if g not in self._sample_many_jit:
+            def many(indptr, indices, edge_ids, seeds_g, key):
+                keys = jax.random.split(key, g)
+
+                def body(carry, inp):
+                    sd, k = inp
+                    return carry, self._sample_impl(indptr, indices,
+                                                    edge_ids, sd, k)
+
+                _, outs = jax.lax.scan(body, jnp.zeros((), jnp.int32),
+                                       (seeds_g, keys))
+                return outs
+
+            self._sample_many_jit[g] = jax.jit(many)
+        gr = self.graph
+        return self._sample_many_jit[g](gr.indptr, gr.indices,
+                                        gr.gather_edge_ids, seeds, key)
 
     def sample_one_hop(self, srcs: jnp.ndarray, fanout: int,
                        key: Optional[jax.Array] = None):

@@ -199,3 +199,23 @@ class TestDedupStrategies:
             np.testing.assert_array_equal(
                 np.asarray(getattr(outs[True], field)),
                 np.asarray(getattr(outs[False], field)), err_msg=field)
+
+    def test_batched_matches_single(self):
+        """sample_from_nodes_batched(G batches) equals G independent
+        single-batch samples with the same per-batch keys."""
+        from glt_tpu.sampler import NeighborSampler, NodeSamplerInput
+
+        g = Graph(ring_graph(), mode="HOST")
+        s = NeighborSampler(g, [2, 2], batch_size=6, seed=0)
+        seeds = np.stack([np.arange(0, 6), np.arange(6, 12),
+                          np.arange(12, 18)])
+        key = jax.random.PRNGKey(9)
+        outs = s.sample_from_nodes_batched(seeds, key=key)
+        keys = jax.random.split(key, 3)
+        for i in range(3):
+            single = s.sample_from_nodes(NodeSamplerInput(seeds[i]),
+                                         key=keys[i])
+            for field in ("node", "row", "col", "node_mask", "edge_mask"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(outs, field))[i],
+                    np.asarray(getattr(single, field)), err_msg=field)
