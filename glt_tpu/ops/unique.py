@@ -98,6 +98,12 @@ class DenseInduceState(NamedTuple):
     count: jnp.ndarray
 
 
+def dense_map_fits(num_nodes: int, budget_bytes: int = 1 << 30) -> bool:
+    """Whether a dense id->local map for ``num_nodes`` fits the budget
+    (the 'auto' dedup heuristic shared by every sampler)."""
+    return num_nodes * 4 <= budget_bytes
+
+
 def dense_induce_init(num_nodes: int, capacity: int) -> DenseInduceState:
     """Fresh per-batch state (the analog of ``Inducer::Reset``,
     csrc/cpu/inducer.cc; allocating zeros is a ~4B/node memset)."""
@@ -149,8 +155,11 @@ def dense_induce(state: DenseInduceState, cand: jnp.ndarray
         jnp.where(is_first, local_new + 1, 0))
     local = jnp.where(valid, seen[safe] - 1, -1)
     dump = node_buf.shape[0] - 1
-    node_buf = node_buf.at[jnp.where(is_first, local_new, dump)].set(
-        jnp.where(is_first, cand, -1))
+    # Defensive clamp: callers that size node_buf below the worst case
+    # (capped hetero buffers) overflow into the dump slot; the node keeps
+    # its >=capacity local id in `seen`, so its edges are maskable.
+    slot = jnp.minimum(jnp.where(is_first, local_new, dump), dump)
+    node_buf = node_buf.at[slot].set(jnp.where(is_first, cand, -1))
     count = count + jnp.sum(is_first.astype(jnp.int32))
     return DenseInduceState(seen, node_buf, count), local
 

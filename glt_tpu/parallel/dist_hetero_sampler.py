@@ -70,6 +70,12 @@ class DistHeteroNeighborSampler:
         p.num_hops = max(len(v) for v in p.num_neighbors.values())
         p.input_type = input_type
         p.batch_size = int(batch_size)
+        # Global per-type node counts so the planner's dense inducer
+        # engages (ids here are global across shards).
+        p._num_nodes_by_type = {}
+        for et, g in sharded.items():
+            p._num_nodes_by_type.setdefault(
+                et[0], g.nodes_per_shard * g.num_shards)
         self.input_type = input_type
         self.batch_size = int(batch_size)
         self._base_key = jax.random.PRNGKey(seed)

@@ -30,7 +30,13 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.neighbor_sample import _row_offsets_and_degrees, sample_neighbors
-from ..ops.unique import relabel_by_reference, unique_first_occurrence
+from ..ops.unique import (
+    dense_induce,
+    dense_induce_init,
+    dense_map_fits,
+    relabel_by_reference,
+    unique_first_occurrence,
+)
 from ..sampler.base import NegativeSampling, SamplerOutput
 from ..sampler.neighbor_sampler import hop_widths, max_sampled_nodes
 from ..typing import PADDING_ID
@@ -211,6 +217,7 @@ def dist_sample_multi_hop(
     axis_name: str,
     frontier_cap: Optional[int] = None,
     collective: str = "all_to_all",
+    dedup: str = "auto",
 ) -> SamplerOutput:
     """Per-shard multi-hop sampling body; call inside ``shard_map``.
 
@@ -218,20 +225,35 @@ def dist_sample_multi_hop(
     ``NeighborSampler._sample_impl`` — frontier, cumulative
     first-occurrence dedup, relabeled COO — with
     :func:`exchange_one_hop` (or its ring variant, ``collective='ring'``)
-    as the one-hop primitive.
+    as the one-hop primitive.  ``dedup`` selects the inducer like the
+    single-device sampler: 'dense' keeps a per-shard O(N_global) id map
+    (4B per global node per shard — measured ~4x cheaper than the
+    argsorts at wide frontiers), 'sort' the growing argsort buffer;
+    'auto' prefers dense up to a ~1GB map.
     """
     exchange = (exchange_one_hop if collective == "all_to_all"
                 else exchange_one_hop_ring)
     fanouts = list(num_neighbors)
     widths = hop_widths(seeds.shape[0], fanouts, frontier_cap)
     cap = max_sampled_nodes(seeds.shape[0], fanouts, frontier_cap)
+    num_global = nodes_per_shard * num_shards
+    if dedup == "auto":
+        dedup = "dense" if dense_map_fits(num_global) else "sort"
+    dense = dedup == "dense"
 
-    u0 = unique_first_occurrence(seeds)
-    # Growing unique buffer (see NeighborSampler._sample_impl): hop i only
-    # sorts what can exist by hop i.
-    node_buf = u0.uniques
-    count = u0.count
-    frontier = u0.uniques
+    if dense:
+        state = dense_induce_init(num_global, cap)
+        state, _ = dense_induce(state, seeds)
+        node_buf = state.node_buf
+        count = state.count
+        frontier = node_buf[: widths[0]]
+    else:
+        u0 = unique_first_occurrence(seeds)
+        # Growing unique buffer (see NeighborSampler._sample_impl): hop i
+        # only sorts what can exist by hop i.
+        node_buf = u0.uniques
+        count = u0.count
+        frontier = u0.uniques
     frontier_start = jnp.zeros((), jnp.int32)
 
     rows, cols, eids_out, emasks = [], [], [], []
@@ -248,11 +270,18 @@ def dist_sample_multi_hop(
         src_local = frontier_start + jnp.arange(w, dtype=jnp.int32)
         src_local = jnp.where(frontier >= 0, src_local, PADDING_ID)
 
-        buflen = node_buf.shape[0]
-        merged = unique_first_occurrence(
-            jnp.concatenate([node_buf, nbrs.ravel()]))
-        node_buf = merged.uniques
-        nbr_local = merged.inverse[buflen:].reshape(w, f)
+        if dense:
+            state, nbr_local = dense_induce(state, nbrs.ravel())
+            node_buf = state.node_buf
+            new_count = state.count
+            nbr_local = nbr_local.reshape(w, f)
+        else:
+            buflen = node_buf.shape[0]
+            merged = unique_first_occurrence(
+                jnp.concatenate([node_buf, nbrs.ravel()]))
+            node_buf = merged.uniques
+            new_count = merged.count
+            nbr_local = merged.inverse[buflen:].reshape(w, f)
         nbr_local = jnp.where(mask, nbr_local, PADDING_ID)
 
         rows.append(nbr_local.ravel())
@@ -261,7 +290,6 @@ def dist_sample_multi_hop(
         emasks.append(mask.ravel())
         edges_per_hop.append(jnp.sum(mask.astype(jnp.int32)))
 
-        new_count = merged.count
         if i + 1 < len(fanouts):
             nw = widths[i + 1]
             frontier = lax.dynamic_slice(

@@ -21,7 +21,12 @@ import numpy as np
 from ..data.graph import Graph
 from ..ops.negative_sample import sample_negative_edges, weighted_draw
 from ..ops.neighbor_sample import sample_neighbors
-from ..ops.unique import unique_first_occurrence
+from ..ops.unique import (
+    dense_induce,
+    dense_induce_init,
+    dense_map_fits,
+    unique_first_occurrence,
+)
 from ..typing import EdgeType, NodeType, PADDING_ID, reverse_edge_type
 from ..ops.unique import relabel_by_reference
 from .base import BaseSampler, HeteroSamplerOutput, NodeSamplerInput
@@ -106,6 +111,24 @@ class HeteroNeighborSampler(BaseSampler):
             {input_type: self.batch_size}, self.num_hops,
             frontier_cap=frontier_cap)
         self.node_types = sorted(self._capacity.keys())
+        # Per-type node counts for the dense inducer.  A type's id space
+        # must cover BOTH roles: its CSR row count where it is a source
+        # AND the max destination id arriving from other edge types
+        # (CSRTopo derives num_nodes from one edge type's own ids, so a
+        # source-only bound can undercount and silently drop neighbors).
+        # Types with no evidence fall back to the sort-based inducer.
+        self._num_nodes_by_type = {}
+        for et, g in graphs.items():
+            if g is None:
+                continue
+            src_t, _, dst_t = et
+            self._num_nodes_by_type[src_t] = max(
+                self._num_nodes_by_type.get(src_t, 0), g.num_nodes)
+            idx = np.asarray(g.topo.indices)
+            if idx.size:
+                self._num_nodes_by_type[dst_t] = max(
+                    self._num_nodes_by_type.get(dst_t, 0),
+                    int(idx.max()) + 1)
         self._sample_jit = jax.jit(
             partial(self._sample_impl, self._widths, self._capacity))
         self._edges_jit = {}
@@ -124,8 +147,19 @@ class HeteroNeighborSampler(BaseSampler):
         exchange here, keeping this multi-hop body single-source."""
         node_types = sorted(cap.keys())
 
+        # Per-type inducer choice: dense O(N_t) scatter map when the
+        # type's node count is known and the map is small enough
+        # (mirrors NeighborSampler's dedup='auto'); sort otherwise.
+        dense_state = {}
+        for t in node_types:
+            n_t = self._num_nodes_by_type.get(t)
+            if n_t is not None and dense_map_fits(n_t):
+                dense_state[t] = dense_induce_init(n_t, max(cap[t], 1))
+
         node_buf = {
-            t: jnp.full((max(cap[t], 1),), PADDING_ID, jnp.int32)
+            t: (dense_state[t].node_buf[: max(cap[t], 1)]
+                if t in dense_state
+                else jnp.full((max(cap[t], 1),), PADDING_ID, jnp.int32))
             for t in node_types}
         count = {t: jnp.zeros((), jnp.int32) for t in node_types}
         frontier = {t: None for t in node_types}
@@ -133,10 +167,18 @@ class HeteroNeighborSampler(BaseSampler):
                           for t in node_types}
 
         for t0, seeds in seeds_dict.items():
-            u0 = unique_first_occurrence(seeds)
-            node_buf[t0] = node_buf[t0].at[: seeds.shape[0]].set(u0.uniques)
-            count[t0] = u0.count
-            frontier[t0] = u0.uniques
+            if t0 in dense_state:
+                dense_state[t0], _ = dense_induce(dense_state[t0], seeds)
+                buflen0 = node_buf[t0].shape[0]
+                node_buf[t0] = dense_state[t0].node_buf[:buflen0]
+                count[t0] = jnp.minimum(dense_state[t0].count, buflen0)
+                frontier[t0] = node_buf[t0][: seeds.shape[0]]
+            else:
+                u0 = unique_first_occurrence(seeds)
+                node_buf[t0] = (node_buf[t0].at[: seeds.shape[0]]
+                                .set(u0.uniques))
+                count[t0] = u0.count
+                frontier[t0] = u0.uniques
 
         rows = {et: [] for et in self.edge_types}
         cols = {et: [] for et in self.edge_types}
@@ -178,18 +220,29 @@ class HeteroNeighborSampler(BaseSampler):
                 cands = jnp.concatenate(
                     [hop_out[et][0].nbrs.ravel() for et in ets])
                 buflen = node_buf[t].shape[0]
-                merged = unique_first_occurrence(
-                    jnp.concatenate([node_buf[t], cands]))
-                # per-etype segments of the inverse array
-                off = buflen
+                if t in dense_state:
+                    dense_state[t], locs = dense_induce(dense_state[t],
+                                                        cands)
+                    uniques_src = dense_state[t].node_buf
+                    merged_count = dense_state[t].count
+                    inverse_tail = locs
+                    off = 0
+                else:
+                    merged = unique_first_occurrence(
+                        jnp.concatenate([node_buf[t], cands]))
+                    uniques_src = merged.uniques
+                    merged_count = merged.count
+                    inverse_tail = merged.inverse
+                    off = buflen
+                # per-etype segments of the candidates' local ids
                 for et in ets:
                     out, src_local, w, f = hop_out[et]
-                    nbr_local = merged.inverse[off: off + w * f].reshape(w, f)
+                    nbr_local = inverse_tail[off: off + w * f].reshape(w, f)
                     off += w * f
                     # With a frontier_cap the unique buffer can fill before
                     # every candidate lands; edges to dropped nodes must be
                     # masked, or nbr_local would index past the buffer.
-                    ok = out.mask & (nbr_local < buflen)
+                    ok = out.mask & (nbr_local >= 0) & (nbr_local < buflen)
                     nbr_local = jnp.where(ok, nbr_local, PADDING_ID)
                     # reversed edge type, transposed direction
                     rows[et].append(nbr_local.ravel())
@@ -201,14 +254,16 @@ class HeteroNeighborSampler(BaseSampler):
                 old_count = count[t]
                 nw = widths[hop + 1][t]
                 if nw > 0 and hop + 1 < self.num_hops + 1:
+                    # Slice strictly within the buffer: overflowed nodes
+                    # (and the dense dump slot) never become frontier.
                     new_frontier[t] = jax.lax.dynamic_slice(
                         jnp.concatenate(
-                            [merged.uniques,
+                            [uniques_src[:buflen],
                              jnp.full((nw,), PADDING_ID, jnp.int32)]),
-                        (jnp.clip(old_count, 0, merged.uniques.shape[0]),),
+                        (jnp.clip(old_count, 0, buflen),),
                         (nw,))
-                node_buf[t] = merged.uniques[:buflen]
-                count[t] = jnp.minimum(merged.count, buflen)
+                node_buf[t] = uniques_src[:buflen]
+                count[t] = jnp.minimum(merged_count, buflen)
                 frontier_start[t] = old_count
 
             for t in node_types:

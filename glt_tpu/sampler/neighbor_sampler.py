@@ -42,6 +42,7 @@ from ..ops.subgraph import node_subgraph
 from ..ops.unique import (
     dense_induce,
     dense_induce_init,
+    dense_map_fits,
     relabel_by_reference,
     unique_first_occurrence,
 )
@@ -122,7 +123,7 @@ class NeighborSampler(BaseSampler):
         if dedup not in ("auto", "dense", "sort"):
             raise ValueError(f"dedup must be auto|dense|sort, got {dedup!r}")
         if dedup == "auto":
-            dedup = "dense" if graph.num_nodes * 4 <= (1 << 30) else "sort"
+            dedup = "dense" if dense_map_fits(graph.num_nodes) else "sort"
         self.dedup = dedup
 
         self._widths = hop_widths(self.batch_size, self.num_neighbors,

@@ -238,3 +238,34 @@ class TestFrontierCap:
             for r, c in zip(row[m], col[m]):
                 assert edge_ok(et, rev_dst[c], rev_src[r]), (et, rev_dst[c],
                                                              rev_src[r])
+
+
+class TestHeteroDedupStrategies:
+    def test_dense_matches_sort(self):
+        """Per-type dense scatter-map inducer equals the argsort path on
+        identical keys (hetero analog of the homo equivalence test)."""
+        ds = hetero_dataset()
+        key = jax.random.PRNGKey(11)
+        seeds = np.arange(6)
+
+        def sample(force_sort):
+            s = HeteroNeighborSampler(ds.graph, {ET_UI: [2, 2],
+                                                 ET_IU: [2, 2]},
+                                      input_type="user", batch_size=6,
+                                      seed=0)
+            if force_sort:
+                s._num_nodes_by_type = {}  # before first trace
+            return s.sample_from_nodes(NodeSamplerInput(seeds), key=key)
+
+        a, b = sample(False), sample(True)
+        for field in ("node", "row", "col", "node_mask", "edge_mask",
+                      "num_sampled_nodes", "num_sampled_edges"):
+            da, db = getattr(a, field), getattr(b, field)
+            if da is None or db is None:
+                assert da is db, field
+                continue
+            assert set(da.keys()) == set(db.keys()), field
+            for k in da:
+                np.testing.assert_array_equal(
+                    np.asarray(da[k]), np.asarray(db[k]),
+                    err_msg=f"{field}[{k}]")
