@@ -13,6 +13,7 @@ from .dist_context import (
 )
 from .dist_dataset import DistDataset
 from .dist_loader import (
+    DistHeteroNeighborLoader,
     DistLinkNeighborLoader,
     DistNeighborLoader,
     DistSubGraphLoader,
@@ -23,6 +24,7 @@ __all__ = [
     "CollocatedSamplingWorkerOptions",
     "DistContext",
     "DistDataset",
+    "DistHeteroNeighborLoader",
     "DistRole",
     "get_context",
     "init_client_context",

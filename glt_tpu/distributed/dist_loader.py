@@ -135,6 +135,54 @@ class DistNeighborLoader(_DistLoaderBase):
             shuffle=shuffle, seed=seed, **kind_kwargs)
 
 
+class DistHeteroNeighborLoader(_DistLoaderBase):
+    """Worker-mode heterogeneous neighbor loader.
+
+    The reference reaches hetero through the same DistNeighborLoader with
+    a (type, ids) seed tuple (dist_neighbor_loader.py:28 +
+    dist_neighbor_sampler.py:270-288); here the hetero front-end is its
+    own class for static typing of the delivered :class:`HeteroBatch`.
+    ``input_nodes`` is ``(node_type, ids)``; channel messages carry the
+    per-type flattening (sample_message.hetero_batch_to_message).
+    """
+
+    _KIND = "hetero_node"
+
+    def __init__(
+        self,
+        num_neighbors,
+        input_nodes,
+        batch_size: int = 512,
+        shuffle: bool = False,
+        frontier_cap: Optional[int] = None,
+        dataset=None,
+        dataset_builder: Optional[Callable] = None,
+        builder_args: tuple = (),
+        worker_options=None,
+        seed: int = 0,
+    ):
+        if not (isinstance(input_nodes, tuple) and len(input_nodes) == 2):
+            raise ValueError(
+                "input_nodes must be (node_type, ids) for hetero loading")
+        input_type, ids = input_nodes
+        super().__init__(
+            num_neighbors, np.asarray(ids).astype(np.int64),
+            batch_size=batch_size, shuffle=shuffle, dataset=dataset,
+            dataset_builder=dataset_builder, builder_args=builder_args,
+            worker_options=worker_options, seed=seed,
+            input_type=input_type, frontier_cap=frontier_cap)
+
+    def _make_inner(self, dataset, num_neighbors, input_seeds, batch_size,
+                    shuffle, seed, kind_kwargs):
+        from ..loader.hetero_neighbor_loader import HeteroNeighborLoader
+
+        return HeteroNeighborLoader(
+            dataset, num_neighbors,
+            (kind_kwargs["input_type"], input_seeds),
+            batch_size=batch_size, shuffle=shuffle,
+            frontier_cap=kind_kwargs.get("frontier_cap"), seed=seed)
+
+
 class DistLinkNeighborLoader(_DistLoaderBase):
     """Worker-mode link loader (cf. dist_link_neighbor_loader.py:31).
 

@@ -57,16 +57,27 @@ def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
     from ..loader.node_loader import NodeLoader
     from ..sampler.base import EdgeSamplerInput, NodeSamplerInput
     from ..sampler.neighbor_sampler import NeighborSampler
+    from .sample_message import hetero_batch_to_message
 
     kk = kind_kwargs or {}
     data = dataset_builder(*builder_args)
-    sampler = NeighborSampler(data.get_graph(), num_neighbors,
-                              batch_size=batch_size,
-                              frontier_cap=kk.get("frontier_cap"),
-                              with_edge=kk.get("with_edge", True),
-                              seed=seed + worker_id)
-    collate_loader = NodeLoader(data, sampler, np.empty(0, np.int64),
-                                batch_size=batch_size)
+    if kind == "hetero_node":
+        from ..loader.hetero_neighbor_loader import HeteroNeighborLoader
+
+        input_type = kk["input_type"]
+        collate_loader = HeteroNeighborLoader(
+            data, num_neighbors, (input_type, np.empty(0, np.int64)),
+            batch_size=batch_size, frontier_cap=kk.get("frontier_cap"),
+            seed=seed + worker_id)
+        sampler = collate_loader.sampler
+    else:
+        sampler = NeighborSampler(data.get_graph(), num_neighbors,
+                                  batch_size=batch_size,
+                                  frontier_cap=kk.get("frontier_cap"),
+                                  with_edge=kk.get("with_edge", True),
+                                  seed=seed + worker_id)
+        collate_loader = NodeLoader(data, sampler, np.empty(0, np.int64),
+                                    batch_size=batch_size)
 
     # Link chunks arrive as (edge_label_index[2, n], labels-or-None) slices
     # shipped in the task payload; node/subgraph chunks are id arrays.
@@ -79,6 +90,9 @@ def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
         if kind == "node":
             return sampler.sample_from_nodes(
                 NodeSamplerInput(payload[lo:hi]))
+        if kind == "hetero_node":
+            return sampler.sample_from_nodes(
+                NodeSamplerInput(payload[lo:hi], kk["input_type"]))
         if kind == "link":
             eli_c, lab_c = payload
             return sampler.sample_from_edges(EdgeSamplerInput(
@@ -99,7 +113,10 @@ def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
             hi = min(lo + batch_size, n)
             out = sample(payload, lo, hi)
             batch = collate_loader._collate_fn(out, hi - lo)
-            msg = batch_to_message(batch)
+            if kind == "hetero_node":
+                msg = hetero_batch_to_message(batch)
+            else:
+                msg = batch_to_message(batch)
             # Provenance tag so the trainer can attribute delivered batches
             # per worker and reissue a dead worker's unfinished seed range.
             msg[_WORKER_KEY] = np.array([worker_id], np.int64)

@@ -42,7 +42,90 @@ def batch_to_message(batch: Batch) -> SampleMessage:
     return msg
 
 
-def message_to_batch(msg: SampleMessage, to_device: bool = True) -> Batch:
+_HET = "#HETERO"
+_ET_SEP = "|"
+
+
+def _et_key(et) -> str:
+    if any(_ET_SEP in part for part in et):
+        raise ValueError(
+            f"edge-type components must not contain {_ET_SEP!r} "
+            f"(got {et!r}); rename the relation for channel transport")
+    return _ET_SEP.join(et)
+
+
+def _et_parse(s: str):
+    a, b, c = s.split(_ET_SEP)
+    return (a, b, c)
+
+
+def hetero_batch_to_message(batch) -> SampleMessage:
+    """Flatten a :class:`HeteroBatch` into string-keyed host arrays
+    (the reference's ``#IS_HETERO`` / per-type key flattening,
+    dist_neighbor_sampler.py:600-673)."""
+    msg: SampleMessage = {
+        _HET: np.array(1, np.int64),
+        _META_BS: np.array(batch.batch_size, np.int64),
+        "#input_type": np.frombuffer(
+            str(batch.input_type).encode(), dtype=np.uint8).copy(),
+    }
+    for t, v in batch.node.items():
+        msg[f"node@{t}"] = np.asarray(v)
+    for t, v in batch.node_mask.items():
+        msg[f"node_mask@{t}"] = np.asarray(v)
+    for et, v in batch.edge_index.items():
+        msg[f"ei@{_et_key(et)}"] = np.asarray(v)
+    for et, v in (batch.edge_id or {}).items():
+        if v is not None:
+            msg[f"eid@{_et_key(et)}"] = np.asarray(v)
+    for et, v in batch.edge_mask.items():
+        msg[f"em@{_et_key(et)}"] = np.asarray(v)
+    for t, v in (batch.x or {}).items():
+        msg[f"x@{t}"] = np.asarray(v)
+    for t, v in (batch.y or {}).items():
+        msg[f"y@{t}"] = np.asarray(v)
+    for t, v in (batch.batch or {}).items():
+        msg[f"batch@{t}"] = np.asarray(v)
+    for k, v in (batch.metadata or {}).items():
+        msg[f"#META.{k}"] = np.asarray(v)
+    return msg
+
+
+def message_to_hetero_batch(msg: SampleMessage, to_device: bool = True):
+    from ..loader.transform import HeteroBatch
+
+    conv = jnp.asarray if to_device else np.asarray
+
+    def group(prefix, et=False):
+        out = {}
+        for k, v in msg.items():
+            if k.startswith(prefix + "@"):
+                key = k[len(prefix) + 1:]
+                out[_et_parse(key) if et else key] = conv(v)
+        return out
+
+    meta = {k[len("#META."):]: conv(v) for k, v in msg.items()
+            if k.startswith("#META.") and k != _META_BS}
+    return HeteroBatch(
+        x=group("x") or {},
+        y=group("y") or None,
+        edge_index=group("ei", et=True),
+        edge_id=group("eid", et=True),
+        node=group("node"),
+        node_mask=group("node_mask"),
+        edge_mask=group("em", et=True),
+        batch=group("batch") or None,
+        batch_size=int(np.asarray(msg[_META_BS]).ravel()[0]),
+        input_type=bytes(np.asarray(msg["#input_type"])).decode(),
+        metadata=meta or None,
+    )
+
+
+def message_to_batch(msg: SampleMessage, to_device: bool = True):
+    """Reconstruct a Batch — or a HeteroBatch when the hetero marker is
+    present (cf. the reference's #IS_HETERO dispatch, dist_loader.py:286)."""
+    if _HET in msg:
+        return message_to_hetero_batch(msg, to_device=to_device)
     conv = jnp.asarray if to_device else np.asarray
     meta = {k[len("#META."):]: conv(v) for k, v in msg.items()
             if k.startswith("#META.") and k != _META_BS}
