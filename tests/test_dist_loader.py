@@ -259,3 +259,40 @@ class TestDistHeteroLoader:
                 assert sorted(seen) == list(range(16))
         finally:
             loader.shutdown()
+
+
+def test_hetero_message_roundtrip_with_metadata():
+    """Hetero flattening carries metadata and rejects separator-bearing
+    edge types (channel-transport contract)."""
+    import pytest
+    from glt_tpu.distributed.sample_message import (
+        hetero_batch_to_message, message_to_batch)
+    from glt_tpu.loader.transform import HeteroBatch
+
+    et = ("user", "clicks", "item")
+    b = HeteroBatch(
+        x={"user": np.ones((4, 2), np.float32)},
+        y={"user": np.arange(4)},
+        edge_index={et: np.zeros((2, 5), np.int32)},
+        edge_id={et: np.arange(5)},
+        node={"user": np.arange(4), "item": np.arange(3)},
+        node_mask={"user": np.ones(4, bool), "item": np.ones(3, bool)},
+        edge_mask={et: np.ones(5, bool)},
+        batch={"user": np.arange(2)},
+        batch_size=2, input_type="user",
+        metadata={"edge_label": np.array([1, 0, 1])})
+    back = message_to_batch(hetero_batch_to_message(b))
+    assert back.input_type == "user"
+    assert back.batch_size == 2
+    np.testing.assert_array_equal(np.asarray(back.metadata["edge_label"]),
+                                  [1, 0, 1])
+    np.testing.assert_array_equal(np.asarray(back.edge_index[et]),
+                                  b.edge_index[et])
+
+    bad = HeteroBatch(
+        x={}, y=None, edge_index={("u", "a|b", "v"): np.zeros((2, 1))},
+        edge_id={}, node={}, node_mask={},
+        edge_mask={("u", "a|b", "v"): np.ones(1, bool)},
+        batch=None, batch_size=1, input_type="u")
+    with pytest.raises(ValueError, match="components"):
+        hetero_batch_to_message(bad)
