@@ -189,4 +189,14 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception:  # noqa: BLE001
+        # The axon tunnel's remote-compile service intermittently drops a
+        # response mid-stream ("read body: response body closed"); one
+        # retry hits warm compile caches and reliably completes.
+        import traceback
+
+        traceback.print_exc()
+        print("retrying once (transient tunnel error)", file=sys.stderr)
+        main()
