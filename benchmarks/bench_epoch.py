@@ -49,6 +49,15 @@ CONFIGS = {
                 "--scale", "0.1", "--epochs", "2"],
         "scale": 0.1,
     },
+    # Config 4 multi-chip (IGBH R-GAT distributed) on the 8-virtual-device
+    # CPU mesh: fused hetero step over per-edge-type sharded CSRs.
+    "igbh_dist_cpu8": {
+        "cmd": [sys.executable, "examples/rgat_igbh.py",
+                "--distributed", "8", "--scale", "0.5", "--epochs", "2"],
+        "scale": 0.5,
+        "env": {"JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+    },
     # Config 5 (papers100M distributed) on the 8-virtual-device CPU mesh:
     # exercises the full partition -> DistDataset.load -> tiered-pipeline
     # path; wall-clock here characterises the code path, not TPU speed.

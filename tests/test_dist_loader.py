@@ -296,3 +296,32 @@ def test_hetero_message_roundtrip_with_metadata():
         batch=None, batch_size=1, input_type="u")
     with pytest.raises(ValueError, match="components"):
         hetero_batch_to_message(bad)
+
+
+def test_mp_link_loader_weighted_negatives():
+    """NegativeSampling.weight survives the spawn boundary: mp workers
+    draw negative endpoints only from the weight's support."""
+    from glt_tpu.distributed import DistLinkNeighborLoader
+    from glt_tpu.sampler.base import NegativeSampling
+
+    support = {3, 7, 11}
+    w = np.zeros(N, np.float32)
+    w[list(support)] = 1.0
+    src = np.arange(N)
+    eli = np.stack([src, (src + 1) % N])
+    loader = DistLinkNeighborLoader(
+        [2], eli, neg_sampling=NegativeSampling("binary", 2, weight=w),
+        batch_size=6, dataset_builder=build_ring_dataset,
+        worker_options=MpSamplingWorkerOptions(num_workers=2))
+    try:
+        neg_seen = set()
+        for batch in loader:
+            nodes = np.asarray(batch.node)
+            elx = np.asarray(batch.metadata["edge_label_index"])
+            lab = np.asarray(batch.metadata["edge_label"])
+            neg = lab == 0
+            neg_seen |= set(nodes[elx[0][neg]].tolist())
+            neg_seen |= set(nodes[elx[1][neg]].tolist())
+        assert neg_seen and neg_seen <= support, neg_seen
+    finally:
+        loader.shutdown()
