@@ -134,11 +134,13 @@ class NeighborLoader(NodeLoader):
         seed: int = 0,
         sampler: Optional[NeighborSampler] = None,
         as_pyg_v1: bool = False,
+        last_hop_dedup: bool = True,
     ):
         if sampler is None:
             sampler = NeighborSampler(
                 data.get_graph(), num_neighbors, batch_size=batch_size,
-                frontier_cap=frontier_cap, with_edge=with_edge, seed=seed)
+                frontier_cap=frontier_cap, with_edge=with_edge, seed=seed,
+                last_hop_dedup=last_hop_dedup)
         super().__init__(data, sampler, input_nodes, batch_size=batch_size,
                          shuffle=shuffle, drop_last=drop_last,
                          prefetch=prefetch, seed=seed)

@@ -186,6 +186,52 @@ def dense_induce(state: DenseInduceState, cand: jnp.ndarray
     return DenseInduceState(seen, node_buf, count), local
 
 
+def dense_induce_final(state: DenseInduceState, cand: jnp.ndarray
+                       ) -> tuple:
+    """Last-hop :func:`dense_induce`: same contract, one fewer map op.
+
+    After the final hop no later hop reads the ``seen`` map, so the
+    commit scatter (op 3 of :func:`dense_induce`) is dead work; losers of
+    the provisional scatter-max resolve through an ``[m]``-sized gather
+    of the winner's freshly assigned id instead of re-reading the map.
+    Saves one full-width random scatter at the widest frontier (the
+    single most expensive op of the whole pipeline).  The returned
+    ``state.seen`` is stale (still holds provisional markers) and MUST
+    NOT be fed to another induce call; ``node_buf``/``count`` are exact.
+    """
+    seen, node_buf, count = state
+    n2 = seen.shape[0]
+    n = n2 - 2
+    m = cand.shape[0]
+    if m >= _PROV_BASE:
+        raise ValueError(f"candidate width {m} exceeds the {_PROV_BASE} "
+                         f"encoding band")
+    cand = cand.astype(jnp.int32)
+    valid = cand >= 0
+    safe = jnp.where(valid, cand, n)
+    pos = jnp.arange(m, dtype=jnp.int32)
+
+    # Op 1 (scatter-max) + op 2 (gather): identical to dense_induce.
+    seen = seen.at[jnp.where(valid, safe, n + 1)].max(
+        jnp.where(valid, _PROV_BASE - pos, 0))
+    won = seen[safe]
+    is_first = valid & (won == _PROV_BASE - pos)
+    local_new = count + jnp.cumsum(is_first.astype(jnp.int32)) - 1
+    # Resolve WITHOUT the commit scatter: committed winners (previous
+    # hops) decode in-register; marker winners (this call) are by
+    # construction is_first slots, so an [m]-gather of local_new at the
+    # winner position replaces the map read-back.
+    winner_pos = jnp.clip(_PROV_BASE - won, 0, m - 1)
+    local = jnp.where(won > _PROV_BASE, _LOCAL_BASE - won,
+                      local_new[winner_pos])
+    local = jnp.where(valid, local, -1)
+    dump = node_buf.shape[0] - 1
+    slot = jnp.minimum(jnp.where(is_first, local_new, dump), dump)
+    node_buf = node_buf.at[slot].set(jnp.where(is_first, cand, -1))
+    count = count + jnp.sum(is_first.astype(jnp.int32))
+    return DenseInduceState(seen, node_buf, count), local
+
+
 def relabel_by_reference(reference_ids: jnp.ndarray, query_ids: jnp.ndarray) -> jnp.ndarray:
     """Map each ``query_id`` to its position in ``reference_ids``.
 

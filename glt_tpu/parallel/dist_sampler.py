@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.neighbor_sample import _row_offsets_and_degrees, sample_neighbors
 from ..ops.unique import (
     dense_induce,
+    dense_induce_final,
     dense_induce_init,
     dense_map_fits,
     relabel_by_reference,
@@ -271,7 +272,11 @@ def dist_sample_multi_hop(
         src_local = jnp.where(frontier >= 0, src_local, PADDING_ID)
 
         if dense:
-            state, nbr_local = dense_induce(state, nbrs.ravel())
+            # The final hop never re-reads the id map: dense_induce_final
+            # drops the dead commit scatter (see ops/unique.py).
+            induce = (dense_induce_final if i + 1 == len(fanouts)
+                      else dense_induce)
+            state, nbr_local = induce(state, nbrs.ravel())
             node_buf = state.node_buf
             new_count = state.count
             nbr_local = nbr_local.reshape(w, f)

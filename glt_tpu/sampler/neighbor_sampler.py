@@ -41,6 +41,7 @@ from ..ops.negative_sample import sample_negative_edges, weighted_draw
 from ..ops.subgraph import node_subgraph
 from ..ops.unique import (
     dense_induce,
+    dense_induce_final,
     dense_induce_init,
     dense_map_fits,
     relabel_by_reference,
@@ -100,6 +101,20 @@ class NeighborSampler(BaseSampler):
       dedup: 'dense' (O(N) scatter-map inducer, ~10x faster at wide
         frontiers), 'sort' (O(M log^2 M) argsort-based, no O(N) state), or
         'auto' (dense unless the id map would exceed ~1GB).
+      last_hop_dedup: when False, final-hop neighbors skip the inducer
+        entirely and land in a contiguous leaf block of the node list
+        (duplicates allowed).  The sampled edge multiset, every edge's
+        endpoint features, and all shapes are identical (static
+        capacities already assume zero dedup); the one semantic change
+        is that a final-hop duplicate of an *interior* node becomes a
+        fresh leaf — it aggregates from raw features instead of reusing
+        the interior node's sampled out-edges (the tree-unrolled
+        semantics of the original GraphSAGE algorithm).  The node list
+        may repeat leaf ids, so ``num_sampled_nodes[-1]`` counts sampled
+        (not unique) leaves.  Cuts the widest frontier from six random
+        element-ops per candidate to one (the neighbor read) — ~1.7x
+        end-to-end; see BASELINE.md.  Default True = exact reference
+        semantics (unique node list, csrc/cuda/inducer.cu:95).
     """
 
     def __init__(
@@ -111,12 +126,14 @@ class NeighborSampler(BaseSampler):
         with_edge: bool = True,
         seed: int = 0,
         dedup: str = "auto",
+        last_hop_dedup: bool = True,
     ):
         self.graph = graph
         self.num_neighbors = list(num_neighbors)
         self.batch_size = int(batch_size)
         self.frontier_cap = frontier_cap
         self.with_edge = with_edge
+        self.last_hop_dedup = bool(last_hop_dedup)
         self._base_key = jax.random.PRNGKey(seed)
         self._call_count = 0
 
@@ -177,9 +194,13 @@ class NeighborSampler(BaseSampler):
         counts_per_hop = [count]
         edges_per_hop = []
         keys = jax.random.split(key, len(fanouts))
+        # Static interior capacity: where the no-dedup leaf block starts.
+        leaf_off = cap - widths[-1] * fanouts[-1]
+        leaf_mask = None
 
         for i, f in enumerate(fanouts):
             w = widths[i]
+            last = i + 1 == len(fanouts)
             out = sample_neighbors(indptr, indices, frontier, f, keys[i],
                                    edge_ids=edge_ids,
                                    with_edge=self.with_edge)
@@ -190,8 +211,24 @@ class NeighborSampler(BaseSampler):
             # Insert this hop's neighbors into the cumulative unique list;
             # old uniques keep their positions.
             cand = out.nbrs.ravel()                        # [w*f]
-            if dense:
-                state, nbr_local = dense_induce(state, cand)
+            if last and not self.last_hop_dedup:
+                # Leaf block: no inducer at the widest frontier.  Local
+                # ids are static offsets; the only memory traffic is one
+                # CONTIGUOUS store of the candidates themselves.
+                leaf_mask = out.mask.ravel()
+                leaf_ids = jnp.where(leaf_mask, cand, PADDING_ID)
+                nbr_local = (leaf_off
+                             + jnp.arange(w * f, dtype=jnp.int32)
+                             ).reshape(w, f)
+                if dense:
+                    node_buf = jax.lax.dynamic_update_slice(
+                        node_buf, leaf_ids, (leaf_off,))
+                else:
+                    node_buf = jnp.concatenate([node_buf, leaf_ids])
+                new_count = count + jnp.sum(leaf_mask.astype(jnp.int32))
+            elif dense:
+                induce = dense_induce_final if last else dense_induce
+                state, nbr_local = induce(state, cand)
                 node_buf = state.node_buf
                 new_count = state.count
                 nbr_local = nbr_local.reshape(w, f)
@@ -211,7 +248,7 @@ class NeighborSampler(BaseSampler):
             emasks.append(out.mask.ravel())
             edges_per_hop.append(jnp.sum(out.mask.astype(jnp.int32)))
 
-            if i + 1 < len(fanouts):
+            if not last:
                 nw = widths[i + 1]
                 frontier = jax.lax.dynamic_slice(
                     jnp.concatenate(
@@ -230,6 +267,14 @@ class NeighborSampler(BaseSampler):
                           jnp.int32)])
         node_buf = node_buf[:cap]
         count = jnp.minimum(count, cap)
+        if leaf_mask is None:
+            node_mask = jnp.arange(cap, dtype=jnp.int32) < count
+        else:
+            # Interior prefix is compact; the leaf block keeps its own
+            # validity mask (holes between interior count and leaf_off).
+            interior = jnp.minimum(count - edges_per_hop[-1], leaf_off)
+            node_mask = (jnp.arange(cap, dtype=jnp.int32) < interior) | (
+                jnp.concatenate([jnp.zeros((leaf_off,), bool), leaf_mask]))
 
         num_sampled_nodes = jnp.stack(
             [counts_per_hop[0]]
@@ -243,7 +288,7 @@ class NeighborSampler(BaseSampler):
             col=jnp.concatenate(cols),
             edge=jnp.concatenate(eids) if self.with_edge else None,
             batch=seeds,
-            node_mask=jnp.arange(cap, dtype=jnp.int32) < count,
+            node_mask=node_mask,
             edge_mask=jnp.concatenate(emasks),
             num_sampled_nodes=num_sampled_nodes,
             num_sampled_edges=jnp.stack(edges_per_hop),
@@ -418,25 +463,30 @@ class NeighborSampler(BaseSampler):
                                     ksample)
 
         meta = {}
+        # Seed ids all first-occur within the hop-0 prefix of the node
+        # list, so relabel against that slice only — with
+        # last_hop_dedup=False the tail leaf block may hold duplicate
+        # copies of a seed, and a leaf copy has no deep embedding.
+        ref = out.node[:seed_width]
         if mode == "binary":
             all_src = jnp.concatenate([src, negs.src])
             all_dst = jnp.concatenate([dst, negs.dst])
             meta["edge_label_index"] = jnp.stack([
-                relabel_by_reference(out.node, all_src),
-                relabel_by_reference(out.node, all_dst),
+                relabel_by_reference(ref, all_src),
+                relabel_by_reference(ref, all_dst),
             ])
         elif mode == "triplet":
-            meta["src_index"] = relabel_by_reference(out.node, src)
-            meta["dst_pos_index"] = relabel_by_reference(out.node, dst)
+            meta["src_index"] = relabel_by_reference(ref, src)
+            meta["dst_pos_index"] = relabel_by_reference(ref, dst)
             meta["dst_neg_index"] = relabel_by_reference(
-                out.node, neg_dst).reshape(q, amount)
+                ref, neg_dst).reshape(q, amount)
         else:
             # No negative sampling still emits edge_label_index so the
             # LinkLoader can locate seed edges in the batch
             # (neighbor_sampler.py:366-372, the None-or-binary branch).
             meta["edge_label_index"] = jnp.stack([
-                relabel_by_reference(out.node, src),
-                relabel_by_reference(out.node, dst),
+                relabel_by_reference(ref, src),
+                relabel_by_reference(ref, dst),
             ])
         out.metadata = meta
         return out
@@ -487,6 +537,10 @@ class NeighborSampler(BaseSampler):
         ``subgraph()``. Subgraph models (SEAL/DGCNN) treat the extract as
         a standalone graph, so the raw direction is preserved.
         """
+        if not self.last_hop_dedup:
+            raise ValueError(
+                "subgraph() requires last_hop_dedup=True: the induced "
+                "extract relabels against a unique node set")
         base = self.sample_from_nodes(inputs, key=key)
         g = self.graph
         sub = node_subgraph(g.indptr, g.indices, base.node, max_degree,
