@@ -31,7 +31,8 @@ _WORKER_KEY = "#worker"
 
 # Sampler-construction kwargs the worker loop honors for the node kind;
 # dist_loader validates mp-mode kwargs against this same set.
-WORKER_SAMPLER_KWARGS = frozenset({"frontier_cap", "with_edge"})
+WORKER_SAMPLER_KWARGS = frozenset({"frontier_cap", "with_edge",
+                                   "last_hop_dedup"})
 
 
 def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
@@ -68,14 +69,17 @@ def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
         collate_loader = HeteroNeighborLoader(
             data, num_neighbors, (input_type, np.empty(0, np.int64)),
             batch_size=batch_size, frontier_cap=kk.get("frontier_cap"),
-            seed=seed + worker_id)
+            seed=seed + worker_id,
+            last_hop_dedup=kk.get("last_hop_dedup", True))
         sampler = collate_loader.sampler
     else:
         sampler = NeighborSampler(data.get_graph(), num_neighbors,
                                   batch_size=batch_size,
                                   frontier_cap=kk.get("frontier_cap"),
                                   with_edge=kk.get("with_edge", True),
-                                  seed=seed + worker_id)
+                                  seed=seed + worker_id,
+                                  last_hop_dedup=kk.get("last_hop_dedup",
+                                                        True))
         collate_loader = NodeLoader(data, sampler, np.empty(0, np.int64),
                                     batch_size=batch_size)
 

@@ -32,6 +32,7 @@ class HeteroNeighborLoader:
         prefetch: int = 2,
         seed: int = 0,
         sampler: Optional[HeteroNeighborSampler] = None,
+        last_hop_dedup: bool = True,
     ):
         if isinstance(input_nodes, tuple):
             input_type, seeds = input_nodes
@@ -51,7 +52,7 @@ class HeteroNeighborLoader:
             sampler = HeteroNeighborSampler(
                 data.graph, num_neighbors, input_type,
                 batch_size=batch_size, frontier_cap=frontier_cap,
-                seed=seed)
+                seed=seed, last_hop_dedup=last_hop_dedup)
         self.sampler = sampler
 
     def __len__(self) -> int:

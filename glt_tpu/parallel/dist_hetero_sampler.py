@@ -52,7 +52,8 @@ class DistHeteroNeighborSampler:
                  num_neighbors, input_type: NodeType,
                  batch_size: int = 512, axis_name: str = "shard",
                  frontier_cap: Optional[int] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 last_hop_dedup: bool = True):
         self.sharded = sharded
         self.mesh = mesh
         self.axis_name = axis_name
@@ -70,6 +71,8 @@ class DistHeteroNeighborSampler:
         p.num_hops = max(len(v) for v in p.num_neighbors.values())
         p.input_type = input_type
         p.batch_size = int(batch_size)
+        p.last_hop_dedup = bool(last_hop_dedup)
+        self.last_hop_dedup = bool(last_hop_dedup)
         # Global per-type node counts so the planner's dense inducer
         # engages (ids here are global across shards).
         p._num_nodes_by_type = {}

@@ -219,6 +219,7 @@ def dist_sample_multi_hop(
     frontier_cap: Optional[int] = None,
     collective: str = "all_to_all",
     dedup: str = "auto",
+    last_hop_dedup: bool = True,
 ) -> SamplerOutput:
     """Per-shard multi-hop sampling body; call inside ``shard_map``.
 
@@ -261,9 +262,12 @@ def dist_sample_multi_hop(
     counts_per_hop = [count]
     edges_per_hop = []
     keys = jax.random.split(key, len(fanouts))
+    leaf_off = cap - widths[-1] * fanouts[-1]
+    leaf_mask = None
 
     for i, f in enumerate(fanouts):
         w = widths[i]
+        last = i + 1 == len(fanouts)
         nbrs, eids, mask = exchange(
             frontier, indptr, indices, edge_ids, nodes_per_shard,
             num_shards, f, keys[i], axis_name)
@@ -271,11 +275,23 @@ def dist_sample_multi_hop(
         src_local = frontier_start + jnp.arange(w, dtype=jnp.int32)
         src_local = jnp.where(frontier >= 0, src_local, PADDING_ID)
 
-        if dense:
+        if last and not last_hop_dedup:
+            # Leaf block (see NeighborSampler.last_hop_dedup): zero map
+            # ops at the widest frontier, one contiguous store.
+            leaf_mask = mask.ravel()
+            leaf_ids = jnp.where(leaf_mask, nbrs.ravel(), PADDING_ID)
+            nbr_local = (leaf_off + jnp.arange(w * f, dtype=jnp.int32)
+                         ).reshape(w, f)
+            if dense:
+                node_buf = lax.dynamic_update_slice(node_buf, leaf_ids,
+                                                    (leaf_off,))
+            else:
+                node_buf = jnp.concatenate([node_buf, leaf_ids])
+            new_count = count + jnp.sum(leaf_mask.astype(jnp.int32))
+        elif dense:
             # The final hop never re-reads the id map: dense_induce_final
             # drops the dead commit scatter (see ops/unique.py).
-            induce = (dense_induce_final if i + 1 == len(fanouts)
-                      else dense_induce)
+            induce = dense_induce_final if last else dense_induce
             state, nbr_local = induce(state, nbrs.ravel())
             node_buf = state.node_buf
             new_count = state.count
@@ -311,6 +327,12 @@ def dist_sample_multi_hop(
              jnp.full((cap - node_buf.shape[0],), PADDING_ID, jnp.int32)])
     node_buf = node_buf[:cap]
     count = jnp.minimum(count, cap)
+    if leaf_mask is None:
+        node_mask = jnp.arange(cap, dtype=jnp.int32) < count
+    else:
+        interior = jnp.minimum(count - edges_per_hop[-1], leaf_off)
+        node_mask = (jnp.arange(cap, dtype=jnp.int32) < interior) | (
+            jnp.concatenate([jnp.zeros((leaf_off,), bool), leaf_mask]))
 
     num_sampled_nodes = jnp.stack(
         [counts_per_hop[0]]
@@ -322,7 +344,7 @@ def dist_sample_multi_hop(
         col=jnp.concatenate(cols),
         edge=jnp.concatenate(eids_out),
         batch=seeds,
-        node_mask=jnp.arange(cap, dtype=jnp.int32) < count,
+        node_mask=node_mask,
         edge_mask=jnp.concatenate(emasks),
         num_sampled_nodes=num_sampled_nodes,
         num_sampled_edges=jnp.stack(edges_per_hop),
@@ -415,9 +437,11 @@ class DistNeighborSampler:
                  frontier_cap: Optional[int] = None,
                  collective: str = "all_to_all",
                  valid_per_shard: Optional[np.ndarray] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 last_hop_dedup: bool = True):
         self.collective = collective
         self.valid_per_shard = valid_per_shard
+        self.last_hop_dedup = bool(last_hop_dedup)
         self._edges_fns = {}
         self._subgraph_fns = {}
         self.g = sharded_graph
@@ -457,7 +481,8 @@ class DistNeighborSampler:
         out = dist_sample_multi_hop(
             indptr_blk[0], indices_blk[0], eids_blk[0], seeds_blk[0], key,
             self.num_neighbors, self.g.nodes_per_shard, self.g.num_shards,
-            self.axis_name, self.frontier_cap, self.collective)
+            self.axis_name, self.frontier_cap, self.collective,
+            last_hop_dedup=self.last_hop_dedup)
         # Re-add the shard axis for shard_map's out_specs.
         return jax.tree.map(lambda x: x[None], out)
 
@@ -556,27 +581,31 @@ class DistNeighborSampler:
 
         out = dist_sample_multi_hop(
             indptr, indices, eids, seeds, ksample, self.num_neighbors,
-            c, s_count, self.axis_name, self.frontier_cap, self.collective)
+            c, s_count, self.axis_name, self.frontier_cap, self.collective,
+            last_hop_dedup=self.last_hop_dedup)
 
+        # Seed ids first-occur in the hop-0 prefix; relabel against that
+        # slice only (the no-dedup leaf block may repeat seed ids).
+        ref = out.node[: seeds.shape[0]]
         meta = {}
         if mode == "binary":
             all_src = jnp.concatenate([src, neg_src])
             all_dst = jnp.concatenate([dst, neg_dst])
             meta["edge_label_index"] = jnp.stack([
-                relabel_by_reference(out.node, all_src),
-                relabel_by_reference(out.node, all_dst)])
+                relabel_by_reference(ref, all_src),
+                relabel_by_reference(ref, all_dst)])
             pos_label = jnp.where(src >= 0, 1, PADDING_ID)
             meta["edge_label"] = jnp.concatenate(
                 [pos_label, jnp.zeros((q * amount,), jnp.int32)])
         elif mode == "triplet":
-            meta["src_index"] = relabel_by_reference(out.node, src)
-            meta["dst_pos_index"] = relabel_by_reference(out.node, dst)
+            meta["src_index"] = relabel_by_reference(ref, src)
+            meta["dst_pos_index"] = relabel_by_reference(ref, dst)
             meta["dst_neg_index"] = relabel_by_reference(
-                out.node, neg_dst).reshape(q, amount)
+                ref, neg_dst).reshape(q, amount)
         else:
             meta["edge_label_index"] = jnp.stack([
-                relabel_by_reference(out.node, src),
-                relabel_by_reference(out.node, dst)])
+                relabel_by_reference(ref, src),
+                relabel_by_reference(ref, dst)])
         out.metadata = meta
         return out
 
@@ -601,11 +630,13 @@ class DistNeighborSampler:
 
             def local(indptr, indices, eids, seeds, key):
                 key = jax.random.fold_in(key, lax.axis_index(self.axis_name))
+                # Always exact dedup here: the induced extract relabels
+                # against a unique node set (cf. NeighborSampler.subgraph).
                 base = dist_sample_multi_hop(
                     indptr[0], indices[0], eids[0], seeds[0], key,
                     self.num_neighbors, self.g.nodes_per_shard,
                     self.g.num_shards, self.axis_name, self.frontier_cap,
-                    self.collective)
+                    self.collective, last_hop_dedup=True)
                 rows, cols, se, mask = dist_node_subgraph(
                     indptr[0], indices[0], eids[0], base.node, max_degree,
                     self.g.nodes_per_shard, self.g.num_shards,

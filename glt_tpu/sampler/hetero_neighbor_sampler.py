@@ -23,6 +23,7 @@ from ..ops.negative_sample import sample_negative_edges, weighted_draw
 from ..ops.neighbor_sample import sample_neighbors
 from ..ops.unique import (
     dense_induce,
+    dense_induce_final,
     dense_induce_init,
     dense_map_fits,
     unique_first_occurrence,
@@ -71,6 +72,17 @@ def hetero_hop_widths(
     return widths, capacity
 
 
+def _node_mask(buf: jnp.ndarray, count: jnp.ndarray, fast) -> jnp.ndarray:
+    """Validity mask for a per-type node buffer: compact prefix, or
+    (interior prefix | leaf-region mask) when the final hop used the
+    no-dedup leaf block."""
+    idx = jnp.arange(buf.shape[0], dtype=jnp.int32)
+    if fast is None:
+        return idx < count
+    leaf_off, leaf_region, interior = fast
+    return (idx < jnp.minimum(interior, leaf_off)) | leaf_region
+
+
 class HeteroNeighborSampler(BaseSampler):
     """Fixed-fanout hetero sampler over per-edge-type :class:`Graph` s.
 
@@ -90,6 +102,7 @@ class HeteroNeighborSampler(BaseSampler):
         batch_size: int = 512,
         frontier_cap: Optional[int] = None,
         seed: int = 0,
+        last_hop_dedup: bool = True,
     ):
         self.graphs = graphs
         self.edge_types = sorted(graphs.keys())
@@ -102,6 +115,7 @@ class HeteroNeighborSampler(BaseSampler):
         self.num_hops = max(len(v) for v in self.num_neighbors.values())
         self.input_type = input_type
         self.batch_size = int(batch_size)
+        self.last_hop_dedup = bool(last_hop_dedup)
         self._base_key = jax.random.PRNGKey(seed)
         self._call_count = 0
 
@@ -185,6 +199,22 @@ class HeteroNeighborSampler(BaseSampler):
         eids = {et: [] for et in self.edge_types}
         emasks = {et: [] for et in self.edge_types}
         counts_hist = {t: [count[t]] for t in node_types}
+        # t -> (leaf_off, full-leaf-region validity mask, interior count)
+        # for types whose final hop used the no-dedup leaf block.
+        fast_leaf = {}
+        # Worst-case interior uniques per type: seeds + every RAW
+        # candidate of hops before the last.  With frontier_cap the
+        # capacity budgets *capped* widths while the inducer inserts raw
+        # candidates, so the interior can outgrow the leaf block — the
+        # fast path must stay off for such types (exact mode masks
+        # overflow into the buffer tail instead).
+        raw_interior = {t: widths[0].get(t, 0) for t in node_types}
+        for h in range(self.num_hops - 1):
+            for et in self.edge_types:
+                fo = self.num_neighbors[et]
+                f = fo[h] if h < len(fo) else 0
+                if f > 0:
+                    raw_interior[et[2]] += widths[h][et[0]] * f
 
         keys = jax.random.split(key, self.num_hops * len(self.edge_types))
 
@@ -220,9 +250,38 @@ class HeteroNeighborSampler(BaseSampler):
                 cands = jnp.concatenate(
                     [hop_out[et][0].nbrs.ravel() for et in ets])
                 buflen = node_buf[t].shape[0]
-                if t in dense_state:
-                    dense_state[t], locs = dense_induce(dense_state[t],
-                                                        cands)
+                total_wf = sum(hop_out[et][2] * hop_out[et][3] for et in ets)
+                # Leaf-block fast path (see NeighborSampler.last_hop_dedup):
+                # only when the final-hop width wasn't frontier_cap-capped
+                # below the raw candidate count (a capped width can't hold
+                # every candidate at a static offset) AND the worst-case
+                # interior fits below the leaf block (it always does when
+                # frontier_cap is None).
+                if (hop + 1 == self.num_hops and not self.last_hop_dedup
+                        and widths[hop + 1][t] >= total_wf
+                        and raw_interior[t] <= buflen - widths[hop + 1][t]):
+                    leaf_off = buflen - widths[hop + 1][t]
+                    cmask = jnp.concatenate(
+                        [hop_out[et][0].mask.ravel() for et in ets])
+                    leaf_ids = jnp.where(cmask, cands, PADDING_ID)
+                    uniques_src = jax.lax.dynamic_update_slice(
+                        node_buf[t], leaf_ids, (leaf_off,))
+                    merged_count = count[t] + jnp.sum(cmask.astype(jnp.int32))
+                    inverse_tail = jnp.where(
+                        cmask,
+                        leaf_off + jnp.arange(total_wf, dtype=jnp.int32),
+                        PADDING_ID)
+                    off = 0
+                    leaf_region = jnp.concatenate([
+                        jnp.zeros((leaf_off,), bool), cmask,
+                        jnp.zeros((buflen - leaf_off - total_wf,), bool)])
+                    fast_leaf[t] = (leaf_off, leaf_region, count[t])
+                elif t in dense_state:
+                    # Final hop: nothing re-reads the id map afterwards,
+                    # so skip the commit scatter (ops/unique.py).
+                    induce = (dense_induce_final
+                              if hop + 1 == self.num_hops else dense_induce)
+                    dense_state[t], locs = induce(dense_state[t], cands)
                     uniques_src = dense_state[t].node_buf
                     merged_count = dense_state[t].count
                     inverse_tail = locs
@@ -253,7 +312,7 @@ class HeteroNeighborSampler(BaseSampler):
 
                 old_count = count[t]
                 nw = widths[hop + 1][t]
-                if nw > 0 and hop + 1 < self.num_hops + 1:
+                if nw > 0 and hop + 1 < self.num_hops:
                     # Slice strictly within the buffer: overflowed nodes
                     # (and the dense dump slot) never become frontier.
                     new_frontier[t] = jax.lax.dynamic_slice(
@@ -284,8 +343,8 @@ class HeteroNeighborSampler(BaseSampler):
             col={rev[et]: cat_or_empty(cols[et]) for et in self.edge_types},
             edge={rev[et]: cat_or_empty(eids[et]) for et in self.edge_types},
             batch=dict(seeds_dict),
-            node_mask={t: (jnp.arange(node_buf[t].shape[0], dtype=jnp.int32)
-                           < count[t]) for t in node_types},
+            node_mask={t: _node_mask(node_buf[t], count[t],
+                                     fast_leaf.get(t)) for t in node_types},
             edge_mask={rev[et]: (cat_or_empty(emasks[et]).astype(bool)
                                  if emasks[et] else
                                  jnp.zeros((0,), bool))
@@ -421,22 +480,29 @@ class HeteroNeighborSampler(BaseSampler):
                     seeds_dict = {src_t: srcs, dst_t: dsts}
                 out = self._sample_impl(widths, cap, graph_arrays,
                                         seeds_dict, ksample)
+                # Seed ids first-occur within the hop-0 prefix of their
+                # type's node list; relabel against that slice only (the
+                # no-dedup leaf block may hold duplicate seed copies).
+                if src_t == dst_t:
+                    src_ref = dst_ref = out.node[src_t][: sw + dw]
+                else:
+                    src_ref = out.node[src_t][:sw]
+                    dst_ref = out.node[dst_t][:dw]
                 meta = {}
                 if mode == "binary":
                     meta["edge_label_index"] = jnp.stack([
-                        relabel_by_reference(out.node[src_t], srcs),
-                        relabel_by_reference(out.node[dst_t], dsts)])
+                        relabel_by_reference(src_ref, srcs),
+                        relabel_by_reference(dst_ref, dsts)])
                 elif mode == "triplet":
-                    meta["src_index"] = relabel_by_reference(
-                        out.node[src_t], src)
+                    meta["src_index"] = relabel_by_reference(src_ref, src)
                     meta["dst_pos_index"] = relabel_by_reference(
-                        out.node[dst_t], dst)
+                        dst_ref, dst)
                     meta["dst_neg_index"] = relabel_by_reference(
-                        out.node[dst_t], neg_dst).reshape(q, amount)
+                        dst_ref, neg_dst).reshape(q, amount)
                 else:
                     meta["edge_label_index"] = jnp.stack([
-                        relabel_by_reference(out.node[src_t], src),
-                        relabel_by_reference(out.node[dst_t], dst)])
+                        relabel_by_reference(src_ref, src),
+                        relabel_by_reference(dst_ref, dst)])
                 out.metadata = meta
                 return out
 

@@ -269,3 +269,88 @@ class TestHeteroDedupStrategies:
                 np.testing.assert_array_equal(
                     np.asarray(da[k]), np.asarray(db[k]),
                     err_msg=f"{field}[{k}]")
+
+    def test_last_hop_nodedup_equivalent_edges(self):
+        """Hetero leaf-block mode: identical global edge multiset per
+        edge type vs the exact path on the same key; masked-in leaf
+        slots resolve to valid global ids."""
+        ds = hetero_dataset()
+        key = jax.random.PRNGKey(19)
+        seeds = np.array([0, 4, 7, 9])
+        outs = {}
+        for lhd in (True, False):
+            s = HeteroNeighborSampler(
+                ds.graph, {ET_UI: [2, 2], ET_IU: [2, 2]},
+                input_type="user", batch_size=4, seed=0,
+                last_hop_dedup=lhd)
+            outs[lhd] = s.sample_from_nodes(
+                NodeSamplerInput(seeds, "user"), key=key)
+
+        def global_edges(out, ret):
+            # ret is the reversed (output) edge type; src side = col,
+            # dst side = row, resolved through the per-type node lists.
+            src_t, _, dst_t = ret
+            m = np.asarray(out.edge_mask[ret])
+            r = np.asarray(out.row[ret])[m]
+            c = np.asarray(out.col[ret])[m]
+            # output convention: row indexes the *reversed* source type
+            src = np.asarray(out.node[dst_t])[c]
+            dst = np.asarray(out.node[src_t])[r]
+            return sorted(zip(src.tolist(), dst.tolist()))
+
+        from glt_tpu.typing import reverse_edge_type
+        for et in (ET_UI, ET_IU):
+            ret = reverse_edge_type(et)
+            assert global_edges(outs[False], ret) == \
+                global_edges(outs[True], ret), ret
+            # every masked-in edge is a real graph edge
+            src_t, _, dst_t = ret
+            m = np.asarray(outs[False].edge_mask[ret])
+            r = np.asarray(outs[False].row[ret])[m]
+            c = np.asarray(outs[False].col[ret])[m]
+            for rr, cc in zip(r, c):
+                s_g = int(np.asarray(outs[False].node[dst_t])[cc])
+                d_g = int(np.asarray(outs[False].node[src_t])[rr])
+                assert edge_ok(et, s_g, d_g), (et, s_g, d_g)
+        # node_mask marks only valid ids
+        for t in ("user", "item"):
+            nm = np.asarray(outs[False].node_mask[t])
+            ids = np.asarray(outs[False].node[t])
+            assert (ids[nm] >= 0).all()
+            assert (ids[~nm] == -1).all()
+        # seeds stay at the front of the seed type
+        assert np.asarray(outs[False].node["user"])[:4].tolist() == \
+            seeds.tolist()
+
+    def test_nodedup_with_frontier_cap_stays_valid(self):
+        """Regression: with frontier_cap capping an interior hop, the
+        capacity budgets capped widths while the inducer inserts raw
+        candidates — the leaf block must NOT engage (it would clobber
+        live interior slots).  Every masked-in edge must be a real graph
+        edge."""
+        # 4-ary tree: i -> 4i+1..4i+4 over one self-typed edge type.
+        n = 200
+        src = np.repeat(np.arange(n), 4)
+        dst = np.minimum(4 * np.repeat(np.arange(n), 4)
+                         + np.tile(np.arange(1, 5), n), n - 1)
+        et = ("n", "e", "n")
+        ds = (Dataset()
+              .init_graph({et: np.stack([src, dst])}, graph_mode="HOST",
+                          num_nodes={"n": n})
+              .init_node_features(
+                  {"n": np.arange(n, dtype=np.float32)[:, None]}))
+        s = HeteroNeighborSampler(ds.graph, {et: [4, 1]}, input_type="n",
+                                  batch_size=4, frontier_cap=8,
+                                  last_hop_dedup=False, seed=0)
+        out = s.sample_from_nodes(
+            NodeSamplerInput(np.array([0, 1, 2, 3]), "n"),
+            key=jax.random.PRNGKey(7))
+        ret = ("n", "e", "n")  # self-typed: reverse keeps the relation
+        node = np.asarray(out.node["n"])
+        m = np.asarray(out.edge_mask[ret])
+        r = np.asarray(out.row[ret])[m]
+        c = np.asarray(out.col[ret])[m]
+        real = set(zip(src.tolist(), dst.tolist()))
+        bad = [(int(node[cc]), int(node[rr])) for rr, cc in zip(r, c)
+               if (int(node[cc]), int(node[rr])) not in real]
+        assert not bad, f"non-edges emitted: {bad[:5]}"

@@ -98,6 +98,40 @@ class TestDistSampler:
                 assert any((v - sd) % n <= 4 for sd in seeds[s])
             assert nsn[s].sum() == len(valid)
 
+    def test_multi_hop_nodedup_leaves(self, mesh):
+        """last_hop_dedup=False on the mesh: same global edge multiset
+        per shard as the exact path, masked-in slots hold valid ids."""
+        n = 64
+        sg = shard_graph(ring_topo(n), N_DEV)
+        seeds = np.array([[i * 8, i * 8 + 5] for i in range(N_DEV)],
+                         np.int32)
+        key = jax.random.PRNGKey(21)
+        outs = {}
+        for lhd in (True, False):
+            samp = DistNeighborSampler(sg, mesh, num_neighbors=[2, 2],
+                                       batch_size=2, seed=1,
+                                       last_hop_dedup=lhd)
+            outs[lhd] = samp.sample_from_nodes(jnp.asarray(seeds), key=key)
+
+        def shard_edges(out, s):
+            node = np.asarray(out.node)[s]
+            m = np.asarray(out.edge_mask)[s]
+            src = node[np.asarray(out.col)[s][m]]
+            dst = node[np.asarray(out.row)[s][m]]
+            return sorted(zip(src.tolist(), dst.tolist()))
+
+        for s in range(N_DEV):
+            assert shard_edges(outs[False], s) == shard_edges(outs[True], s)
+            node = np.asarray(outs[False].node)[s]
+            nmask = np.asarray(outs[False].node_mask)[s]
+            assert (node[nmask] >= 0).all()
+            assert (node[~nmask] == -1).all()
+            # seeds stay first
+            assert node[0] == seeds[s, 0] and node[1] == seeds[s, 1]
+            # every edge is a real ring edge
+            for a, b in shard_edges(outs[False], s):
+                assert (b - a) % n in (1, 2)
+
 
 class TestDistFeature:
     def test_exchange_gather(self, mesh):
