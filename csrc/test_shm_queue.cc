@@ -1,6 +1,9 @@
 // Native unit tests for the shm ring queue (cf. test/cpp/test_shm_queue.cu
 // in the reference). Plain asserts, exit 0 on success; driven by
-// tests/test_channel.py::TestNativeBinary.
+// tests/test_channel.py::TestNativeBinary and CTest (CMakeLists.txt).
+// Asserts here PERFORM the queue operations, so they must survive
+// Release builds.
+#undef NDEBUG
 #include <cassert>
 #include <cstdint>
 #include <cstdio>

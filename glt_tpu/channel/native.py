@@ -13,8 +13,19 @@ _LOCK = threading.Lock()
 _LIB = None
 
 
+# Installed-package location (built by setup.py's BuildWithNative).
+_PKG_SO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "libglt_shm.so")
+
+
 def ensure_built() -> str:
     src = os.path.join(_CSRC, "shm_queue.cc")
+    if not os.path.exists(src):
+        # Installed package: csrc isn't shipped; use the wheel-built lib.
+        if os.path.exists(_PKG_SO):
+            return _PKG_SO
+        raise RuntimeError("libglt_shm.so not found; reinstall glt-tpu or "
+                           "run from a source checkout")
     if (not os.path.exists(_SO)
             or os.path.getmtime(_SO) < os.path.getmtime(src)):
         os.makedirs(os.path.dirname(_SO), exist_ok=True)

@@ -16,6 +16,59 @@ import numpy as np
 from .dataset import Dataset
 
 
+def resolve_reader_factory(reader_factory):
+    """Return ``(factory, out_of_range_exceptions)`` for table draining.
+
+    Defaults to the PAI ``common_io`` reader (gated — the reference's only
+    backend, data/table_dataset.py:30-162); any object with
+    ``read(batch_size, allow_smaller_final_batch=True)`` + ``close()``
+    works in its place.
+    """
+    if reader_factory is None:
+        try:
+            import common_io
+        except ImportError as e:
+            raise ImportError(
+                "table reading without reader_factory needs the PAI "
+                "'common_io' reader; pass reader_factory=... (any object "
+                "with read()/close()) elsewhere") from e
+        return (common_io.table.TableReader,
+                (StopIteration, common_io.exception.OutOfRangeException))
+    try:
+        import common_io
+        return reader_factory, (StopIteration,
+                                common_io.exception.OutOfRangeException)
+    except ImportError:
+        return reader_factory, (StopIteration,)
+
+
+def drain_table(table, reader_factory, oor, batch_size: int = 1024):
+    """Read every record of ``table`` through the reader protocol."""
+    reader = reader_factory(table)
+    records = []
+    try:
+        while True:
+            try:
+                got = reader.read(batch_size,
+                                  allow_smaller_final_batch=True)
+            except oor:
+                break
+            if not got:
+                break
+            records.extend(got)
+    finally:
+        reader.close()
+    return records
+
+
+def parse_feature_field(field) -> list:
+    """Decode a ``"f1:f2:...:fd"`` feature string (str or bytes —
+    table_dataset.py:124-135 in the reference)."""
+    if isinstance(field, bytes):
+        field = field.decode()
+    return [float(v) for v in field.split(":")]
+
+
 class TableDataset(Dataset):
     """Build a Dataset from edge/node tables.
 
@@ -90,40 +143,11 @@ class TableDataset(Dataset):
         Single-entry dicts build a homogeneous dataset; multi-entry
         dicts (keyed by edge type tuple / node type) build hetero.
         """
-        if reader_factory is None:
-            try:
-                import common_io
-            except ImportError as e:
-                raise ImportError(
-                    "from_tables without reader_factory needs the PAI "
-                    "'common_io' reader; pass reader_factory=... (any "
-                    "object with read()/close()) elsewhere") from e
-            reader_factory = common_io.table.TableReader
-            oor = (StopIteration, common_io.exception.OutOfRangeException)
-        else:
-            try:
-                import common_io
-                oor = (StopIteration,
-                       common_io.exception.OutOfRangeException)
-            except ImportError:
-                oor = (StopIteration,)
+        reader_factory, oor = resolve_reader_factory(reader_factory)
 
         def drain(table):
-            reader = reader_factory(table)
-            records = []
-            try:
-                while True:
-                    try:
-                        got = reader.read(reader_batch_size,
-                                          allow_smaller_final_batch=True)
-                    except oor:
-                        break
-                    if not got:
-                        break
-                    records.extend(got)
-            finally:
-                reader.close()
-            return records
+            return drain_table(table, reader_factory, oor,
+                               reader_batch_size)
 
         edge_hetero = len(edge_tables) > 1
         node_hetero = len(node_tables) > 1
@@ -148,12 +172,8 @@ class TableDataset(Dataset):
             recs = drain(table)
             ids = np.array([r[0] for r in recs], dtype=np.int64)
 
-            def parse(field):
-                if isinstance(field, bytes):
-                    field = field.decode()
-                return [float(v) for v in field.split(":")]
-
-            mat = np.asarray([parse(r[1]) for r in recs], np.float32)
+            mat = np.asarray([parse_feature_field(r[1]) for r in recs],
+                             np.float32)
             # Rows are stored BY ID so the graph's raw ids index them
             # directly; gaps get zero features / -1 labels (the reference
             # sorts by id and assumes contiguity, table_dataset.py:126 —

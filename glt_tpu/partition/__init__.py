@@ -5,16 +5,21 @@ from .contiguous import (
     relabel_rows,
     relabel_topology,
 )
+from .dist_random_partitioner import DistRandomPartitioner, hash_partition
+from .dist_table_partitioner import DistTableRandomPartitioner
 from .frequency_partitioner import FrequencyPartitioner
 from .random_partitioner import RandomPartitioner
 
 __all__ = [
     "ContiguousRelabel",
+    "DistRandomPartitioner",
+    "DistTableRandomPartitioner",
     "FrequencyPartitioner",
     "PartitionerBase",
     "RandomPartitioner",
     "cat_feature_cache",
     "contiguous_relabel",
+    "hash_partition",
     "load_partition",
     "relabel_rows",
     "relabel_topology",

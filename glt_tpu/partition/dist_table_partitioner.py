@@ -1,0 +1,85 @@
+"""Distributed partitioning fed directly from table readers.
+
+Rebuild of the reference's ``DistTableRandomPartitioner``
+(``distributed/dist_table_dataset.py:38-147``): there, each rank reads its
+slice of an ODPS edge/node table and pushes rows to owner ranks over RPC.
+Here each rank drains its table slice through the same reader protocol as
+:class:`~glt_tpu.data.table_dataset.TableDataset` (``common_io``-compatible
+``read``/``close``; any factory works) and spills rows per owner through
+the filesystem — the :class:`DistRandomPartitioner` flow, which replaces
+the reference's RPC ``DistPartitionManager`` with stateless hash ownership
+plus shared-filesystem merge.
+
+Usage (one call per rank, then one ``finalize``)::
+
+    p = DistTableRandomPartitioner(out_dir, num_parts=4,
+                                   num_nodes=n, num_edges=e)
+    p.partition_rank_tables(rank, edge_table="odps://.../edges_slice_r",
+                            node_table="odps://.../nodes_slice_r",
+                            edge_id_offset=rank_edge_offset,
+                            reader_factory=my_reader)
+    ...
+    p.finalize()
+
+Record formats match ``TableDataset.from_tables`` exactly: edge tables
+yield ``(src_id, dst_id)``; node tables yield ``(id, "f1:f2:...:fd")``.
+Global edge ids are ``edge_id_offset + position`` within the rank's slice
+(the reference likewise derives ids from per-rank offsets).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..data.table_dataset import (
+    drain_table,
+    parse_feature_field,
+    resolve_reader_factory,
+)
+from .dist_random_partitioner import DistRandomPartitioner
+
+
+class DistTableRandomPartitioner(DistRandomPartitioner):
+    """Per-rank, table-fed distributed random partitioner."""
+
+    def partition_rank_tables(
+        self,
+        rank: int,
+        edge_table,
+        node_table=None,
+        reader_factory=None,
+        edge_id_offset: int = 0,
+        reader_batch_size: int = 1024,
+    ) -> int:
+        """Drain this rank's table slices and spill per-owner rows.
+
+        Returns the number of edges read (so callers can chain
+        ``edge_id_offset`` across ranks when slice sizes aren't known
+        upfront).  Labels are not partitioned — like the reference, label
+        lookup stays a whole-array load at ``DistDataset.load`` time.
+        """
+        factory, oor = resolve_reader_factory(reader_factory)
+        edge_recs = drain_table(edge_table, factory, oor, reader_batch_size)
+        edge_index = np.stack([
+            np.array([r[0] for r in edge_recs], dtype=np.int64),
+            np.array([r[1] for r in edge_recs], dtype=np.int64)])
+        edge_ids = edge_id_offset + np.arange(len(edge_recs), dtype=np.int64)
+
+        node_ids: Optional[np.ndarray] = None
+        node_feat: Optional[np.ndarray] = None
+        if node_table is not None:
+            node_recs = drain_table(node_table, factory, oor,
+                                    reader_batch_size)
+            # An empty slice must not spill: np.asarray([]) is 1-D and
+            # would break finalize's (k, d) feature concatenation.
+            if node_recs:
+                node_ids = np.array([r[0] for r in node_recs],
+                                    dtype=np.int64)
+                node_feat = np.asarray(
+                    [parse_feature_field(r[1]) for r in node_recs],
+                    np.float32)
+
+        self.partition_rank_chunk(rank, edge_index, edge_ids,
+                                  node_ids=node_ids, node_feat=node_feat)
+        return len(edge_recs)

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Throughput benches: headline sampler (bench.py), feature gather, and
+# epoch-time configs.  Run on the real TPU chip (no JAX_PLATFORMS
+# override); each prints JSON lines.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python bench.py
+python benchmarks/bench_feature.py
+python benchmarks/bench_epoch.py "$@"

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Native unit tests via CMake/CTest (cf. scripts/run_cpp_ut.sh in the
+# reference, which runs GTest binaries from built/bin).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+cmake -S . -B build -DCMAKE_BUILD_TYPE=Release >/dev/null
+cmake --build build --parallel >/dev/null
+exec ctest --test-dir build --output-on-failure

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Python unit tests (cf. the reference's scripts/run_python_ut.sh, which
+# shell-loops `python test_*.py`; here the suite is pytest-native).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m pytest tests/ -q "$@"

@@ -1,0 +1,45 @@
+"""Build glt-tpu with its native shm-queue library.
+
+The reference builds one CUDAExtension from ``csrc/**`` gated by
+``WITH_CUDA``/``WITH_VINEYARD`` (setup.py:27-99 there).  The TPU rebuild's
+only native component is the host-side shared-memory ring queue
+(``csrc/shm_queue.cc`` — the CUDA kernels became XLA/Pallas programs), so
+the build is one plain C++ shared library, loaded via ctypes
+(``glt_tpu/channel/native.py``) — no pybind11 required.
+
+``pip install .`` compiles ``libglt_shm.so`` into the installed package;
+running from a source checkout needs no install at all (native.py
+self-builds into ``csrc/build/`` on first use).
+"""
+import os
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        super().run()
+        here = os.path.dirname(os.path.abspath(__file__))
+        src = os.path.join(here, "csrc", "shm_queue.cc")
+        out_dir = os.path.join(self.build_lib, "glt_tpu", "channel")
+        os.makedirs(out_dir, exist_ok=True)
+        out = os.path.join(out_dir, "libglt_shm.so")
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-pthread", "-std=c++17",
+             src, "-o", out, "-lrt"],
+            check=True)
+
+
+class BinaryDistribution(Distribution):
+    """The embedded libglt_shm.so is platform-specific: wheels must carry
+    a platform tag, not py3-none-any."""
+
+    def has_ext_modules(self):
+        return True
+
+
+setup(cmdclass={"build_py": BuildWithNative},
+      distclass=BinaryDistribution)

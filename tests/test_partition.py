@@ -176,6 +176,79 @@ class TestDistRandomPartitioner:
         assert sorted(all_nodes) == list(range(n))
         assert all_edges == ei.shape[1]
 
+    def test_table_fed_partition_roundtrip(self, tmp_path):
+        """DistTableRandomPartitioner: per-rank table slices through the
+        reader protocol produce the same on-disk layout as array-fed
+        partitioning (cf. distributed/dist_table_dataset.py:38-147)."""
+        from glt_tpu.partition import DistTableRandomPartitioner
+        from test_aux import ListTableReader
+
+        n = 30
+        ei = ring(n)
+        feat_str = [f"{i}.0:{2 * i}.0" for i in range(n)]
+        tables = {
+            "edges_r0": list(zip(ei[0, :30].tolist(), ei[1, :30].tolist())),
+            "edges_r1": list(zip(ei[0, 30:].tolist(), ei[1, 30:].tolist())),
+            "nodes_r0": [(i, feat_str[i]) for i in range(15)],
+            "nodes_r1": [(i, feat_str[i]) for i in range(15, n)],
+        }
+        factory = lambda name: ListTableReader(tables[name], batch_limit=7)
+
+        part = DistTableRandomPartitioner(str(tmp_path), 2, n, ei.shape[1],
+                                          seed=3)
+        got = part.partition_rank_tables(0, "edges_r0", "nodes_r0",
+                                         reader_factory=factory,
+                                         edge_id_offset=0,
+                                         reader_batch_size=8)
+        assert got == 30
+        part.partition_rank_tables(1, "edges_r1", "nodes_r1",
+                                   reader_factory=factory,
+                                   edge_id_offset=got, reader_batch_size=8)
+        part.finalize()
+
+        from glt_tpu.partition import load_partition
+        all_nodes, all_edges = [], 0
+        for p in range(2):
+            graph, node_feat, _, npb, _, _ = load_partition(str(tmp_path), p)
+            assert (npb[graph.edge_index[0]] == p).all()
+            # feature row content is f(id): [id, 2*id]
+            np.testing.assert_array_equal(node_feat.feats[:, 0],
+                                          node_feat.ids)
+            np.testing.assert_array_equal(node_feat.feats[:, 1],
+                                          2 * node_feat.ids)
+            all_nodes.extend(node_feat.ids.tolist())
+            all_edges += graph.eids.shape[0]
+        assert sorted(all_nodes) == list(range(n))
+        assert all_edges == ei.shape[1]
+
+    def test_table_fed_empty_node_slice(self, tmp_path):
+        """A rank whose node-table slice is empty must not spill a
+        malformed (0,)-shaped feature array (regression)."""
+        from glt_tpu.partition import DistTableRandomPartitioner
+        from test_aux import ListTableReader
+
+        n = 10
+        ei = ring(n)
+        tables = {
+            "e0": list(zip(ei[0, :10].tolist(), ei[1, :10].tolist())),
+            "e1": list(zip(ei[0, 10:].tolist(), ei[1, 10:].tolist())),
+            "v0": [(i, f"{i}.0") for i in range(n)],
+            "v1": [],
+        }
+        factory = lambda name: ListTableReader(tables[name])
+        part = DistTableRandomPartitioner(str(tmp_path), 2, n, ei.shape[1])
+        got = part.partition_rank_tables(0, "e0", "v0",
+                                         reader_factory=factory)
+        part.partition_rank_tables(1, "e1", "v1", reader_factory=factory,
+                                   edge_id_offset=got)
+        part.finalize()  # must not raise on mixed-dim concatenation
+        from glt_tpu.partition import load_partition
+        ids = []
+        for p in range(2):
+            _, node_feat, _, _, _, _ = load_partition(str(tmp_path), p)
+            ids.extend(node_feat.ids.tolist())
+        assert sorted(ids) == list(range(n))
+
     def test_balance(self, tmp_path):
         from glt_tpu.partition.dist_random_partitioner import hash_partition
         pb = hash_partition(np.arange(100000), 8, 0)
