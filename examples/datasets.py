@@ -116,41 +116,64 @@ def synthetic_ppi(scale: float = 1.0, dim: int = 50, seed: int = 0,
     return ds, np.stack([src, dst])
 
 
+def _synthetic_citation_hetero(node_counts, relations, scale, seed,
+                               graph_mode, label_type="paper", classes=8):
+    """Shared builder for citation-shaped hetero benchmarks.
+
+    ``node_counts``: type -> (floor, base) scaled by ``scale``.
+    ``relations``: (src_t, rel, dst_t, degree, reversed_rel) — a reverse
+    edge type is emitted whenever ``reversed_rel`` is set.  Labels live on
+    ``label_type``; its features are noisy one-hot labels so the task is
+    learnable, other types get pure-noise features.
+    """
+    rng = np.random.default_rng(seed)
+    n = {t: max(floor, int(base * scale))
+         for t, (floor, base) in node_counts.items()}
+
+    ei = {}
+    for src_t, rel, dst_t, deg, rev in relations:
+        src = np.repeat(np.arange(n[src_t]), deg)
+        dst = rng.integers(0, n[dst_t], n[src_t] * deg)
+        edges = np.stack([src, dst])
+        ei[(src_t, rel, dst_t)] = edges
+        if rev is not None:
+            ei[(dst_t, rev, src_t)] = edges[::-1]
+
+    labels = rng.integers(0, classes, n[label_type]).astype(np.int32)
+    feats = {t: rng.normal(size=(c, classes)).astype(np.float32)
+             for t, c in n.items()}
+    feats[label_type] = (np.eye(classes, dtype=np.float32)[labels]
+                         + feats[label_type] * 0.3)
+    ds = (Dataset()
+          .init_graph(ei, graph_mode=graph_mode, num_nodes=n)
+          .init_node_features(feats)
+          .init_node_labels({label_type: labels}))
+    return ds, np.arange(n[label_type]), classes
+
+
 def synthetic_igbh(scale: float = 1.0, seed: int = 0,
                    graph_mode: str = "DEVICE"):
     """IGBH-tiny-shaped hetero graph: paper/author/institute."""
-    rng = np.random.default_rng(seed)
-    n_paper = max(200, int(1000 * scale))
-    n_author = max(150, int(800 * scale))
-    n_inst = max(20, int(80 * scale))
-    classes = 8
+    return _synthetic_citation_hetero(
+        {"paper": (200, 1000), "author": (150, 800), "institute": (20, 80)},
+        [("paper", "cites", "paper", 4, None),
+         ("author", "writes", "paper", 3, "rev_writes"),
+         ("author", "affiliated", "institute", 1, "rev_affiliated")],
+        scale, seed, graph_mode)
 
-    def rand_edges(ns, nd, deg):
-        src = np.repeat(np.arange(ns), deg)
-        dst = rng.integers(0, nd, ns * deg)
-        return np.stack([src, dst])
 
-    cites = rand_edges(n_paper, n_paper, 4)
-    writes = rand_edges(n_author, n_paper, 3)
-    affil = rand_edges(n_author, n_inst, 1)
-    ei = {
-        ("paper", "cites", "paper"): cites,
-        ("author", "writes", "paper"): writes,
-        ("paper", "rev_writes", "author"): writes[::-1],
-        ("author", "affiliated", "institute"): affil,
-        ("institute", "rev_affiliated", "author"): affil[::-1],
-    }
-    labels = rng.integers(0, classes, n_paper).astype(np.int32)
-    feats = {
-        "paper": (np.eye(classes, dtype=np.float32)[labels]
-                  + rng.normal(0, .3, (n_paper, classes)).astype(np.float32)),
-        "author": rng.normal(size=(n_author, classes)).astype(np.float32),
-        "institute": rng.normal(size=(n_inst, classes)).astype(np.float32),
-    }
-    ds = (Dataset()
-          .init_graph(ei, graph_mode=graph_mode,
-                      num_nodes={"paper": n_paper, "author": n_author,
-                                 "institute": n_inst})
-          .init_node_features(feats)
-          .init_node_labels({"paper": labels}))
-    return ds, np.arange(n_paper), classes
+def synthetic_mag(scale: float = 1.0, seed: int = 0,
+                  graph_mode: str = "DEVICE"):
+    """OGB-MAG-shaped hetero graph (the reference's
+    examples/hetero/train_hgt_mag.py dataset): paper / author /
+    institution / field_of_study with MAG's four canonical relations
+    (+ reverses), venue labels on papers."""
+    return _synthetic_citation_hetero(
+        {"paper": (300, 1500), "author": (200, 1000),
+         "institution": (30, 100), "field_of_study": (50, 200)},
+        [("paper", "cites", "paper", 4, None),
+         ("author", "writes", "paper", 3, "rev_writes"),
+         ("author", "affiliated_with", "institution", 1,
+          "rev_affiliated_with"),
+         ("paper", "has_topic", "field_of_study", 2, "rev_has_topic")],
+        scale, seed, graph_mode)

@@ -26,17 +26,37 @@ from .sample_message import message_to_batch
 
 class RemoteServerConnection:
     def __init__(self, addr: Tuple[str, int],
-                 timeout: Optional[float] = 120.0):
+                 timeout: Optional[float] = 600.0):
         # Bounded waits so a dead server surfaces as an error instead of a
         # hang (the reference's RPC timeouts, dist_options.py rpc_timeout).
         self.sock = socket.create_connection(addr, timeout=timeout)
         self.sock.settimeout(timeout)
         self._lock = threading.Lock()
+        # A timeout/short-read mid-exchange leaves an unconsumed response
+        # in flight: the framed protocol is desynced and every later
+        # exchange would misparse.  Poison the connection instead.
+        self._broken = False
+
+    def _exchange(self, payload: bytes):
+        with self._lock:
+            if self._broken:
+                raise RuntimeError("connection poisoned by an earlier "
+                                   "timeout/protocol error; reconnect")
+            try:
+                send_frame(self.sock, _KIND_JSON, payload)
+                kind, data = recv_frame(self.sock)
+            except Exception:
+                self._broken = True
+                raise
+            if kind is None or data is None:
+                # EOF (clean or mid-frame) — the server closed the socket
+                # (e.g. died or dropped us after an error frame).
+                self._broken = True
+                raise RuntimeError("server closed the connection")
+            return kind, data
 
     def request(self, **req) -> dict:
-        with self._lock:
-            send_frame(self.sock, _KIND_JSON, json.dumps(req).encode())
-            kind, data = recv_frame(self.sock)
+        kind, data = self._exchange(json.dumps(req).encode())
         if kind != _KIND_JSON:
             raise RuntimeError("expected JSON response")
         resp = json.loads(data)
@@ -45,15 +65,17 @@ class RemoteServerConnection:
         return resp
 
     def fetch_message(self, producer_id: int):
-        with self._lock:
-            send_frame(self.sock, _KIND_JSON, json.dumps(
-                {"op": "fetch_one_sampled_message",
-                 "producer_id": producer_id}).encode())
-            kind, data = recv_frame(self.sock)
+        kind, data = self._exchange(json.dumps(
+            {"op": "fetch_one_sampled_message",
+             "producer_id": producer_id}).encode())
         if kind != _KIND_MSG:
             raise RuntimeError(
                 json.loads(data).get("error", "bad frame"))
         return deserialize(memoryview(data))
+
+    @property
+    def broken(self) -> bool:
+        return self._broken
 
     def close(self) -> None:
         self.sock.close()
@@ -83,7 +105,8 @@ class RemoteNeighborLoader:
         # An explicit ``prefetch`` argument wins over the options default.
         if prefetch is not None:
             opts = dataclasses.replace(opts, prefetch_size=int(prefetch))
-        self.conn = RemoteServerConnection(server_addr)
+        self.conn = RemoteServerConnection(server_addr,
+                                           timeout=float(opts.rpc_timeout))
         resp = self.conn.request(
             op="create_sampling_producer",
             num_neighbors=list(num_neighbors),
@@ -136,9 +159,10 @@ class RemoteNeighborLoader:
 
     def shutdown(self, exit_server: bool = False) -> None:
         try:
-            self.conn.request(op="destroy_sampling_producer",
-                              producer_id=self.producer_id)
-            if exit_server:
-                self.conn.request(op="exit")
+            if not self.conn.broken:
+                self.conn.request(op="destroy_sampling_producer",
+                                  producer_id=self.producer_id)
+                if exit_server:
+                    self.conn.request(op="exit")
         finally:
             self.conn.close()

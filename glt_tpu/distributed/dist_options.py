@@ -58,3 +58,8 @@ class RemoteSamplingWorkerOptions:
     channel_capacity_bytes: int = 64 * 1024 * 1024
     prefetch_size: int = 4
     worker_seed: int = 0
+    # Socket timeout for every client<->server exchange (the reference's
+    # rpc_timeout, dist_options.py:~90).  Generous default: a first XLA
+    # compile on an oversubscribed host can stall the producer for
+    # minutes before the first batch lands.
+    rpc_timeout: float = 600.0

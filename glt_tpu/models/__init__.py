@@ -1,5 +1,7 @@
 from .conv import GATConv, SAGEConv, scatter_mean, scatter_sum, segment_softmax
 from .gat import GAT
+from .hgt import HGT, HGTConv
+from .rgat import RGAT, HeteroConv
 from .sage import GraphSAGE
 from .train import (
     TrainState,
@@ -13,6 +15,10 @@ __all__ = [
     "GAT",
     "GATConv",
     "GraphSAGE",
+    "HGT",
+    "HGTConv",
+    "HeteroConv",
+    "RGAT",
     "SAGEConv",
     "TrainState",
     "create_train_state",

@@ -1,0 +1,148 @@
+"""Heterogeneous Graph Transformer (HGT), flax-native over hetero batches.
+
+The reference ships no models (GNNs come from PyG — SURVEY §0) but its
+examples train HGT on OGB-MAG (``examples/hetero/train_hgt_mag.py``); a
+complete framework therefore provides the architecture.  This follows Hu
+et al., *Heterogeneous Graph Transformer* (WWW 2020): type-specific K/Q/V
+projections, per-edge-type attention and message transforms with a learned
+relation prior, attention normalized **jointly across all edge types**
+incoming to a destination node, and a gated residual per node type.
+
+Consumes :class:`~glt_tpu.loader.transform.HeteroBatch` tensors: per-type
+node features, per-edge-type padded COO (``edge_index[et][0]`` = message
+source rows into ``x[src_t]``, ``[1]`` = destination rows into
+``x[dst_t]``) and edge masks — the same interface as :class:`RGAT`, so it
+drops into every hetero train step unchanged.
+"""
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..typing import as_str
+
+
+class HGTConv(nn.Module):
+    """One HGT layer.
+
+    Attention for edge ``s -> t`` of type ``et`` with heads ``i``:
+    ``att = (K_i(s) @ W_att[et,i] . Q_i(t)) * mu[et,i] / sqrt(d)``,
+    softmaxed over **all** incoming edges of ``t`` (across edge types);
+    messages are ``V_i(s) @ W_msg[et,i]``; the per-type output is a
+    gated residual ``x + skip_gate * A_t(gelu(agg))``.
+    """
+    edge_types: Sequence[Tuple[str, str, str]]
+    out_features: int
+    heads: int = 2
+
+    @nn.compact
+    def __call__(self, x: Dict[str, jnp.ndarray], edge_index, edge_mask):
+        h = self.heads
+        if self.out_features % h:
+            raise ValueError("heads must divide out_features")
+        d = self.out_features // h
+
+        def per_type(name):
+            return {t: nn.Dense(h * d, use_bias=False,
+                                name=f"{name}_{t}")(v).reshape(-1, h, d)
+                    for t, v in x.items()}
+
+        K, Q, V = per_type("k"), per_type("q"), per_type("v")
+
+        # Per-edge-type raw scores and transformed messages, grouped by
+        # destination type for the joint softmax.
+        grouped: Dict[str, list] = {}
+        for et in self.edge_types:
+            src_t, _, dst_t = et
+            if et not in edge_index or src_t not in x or dst_t not in x:
+                continue
+            ei = edge_index[et]
+            if ei.shape[-1] == 0:
+                continue
+            mask = edge_mask[et]
+            n_src = x[src_t].shape[0]
+            n_dst = x[dst_t].shape[0]
+            s_idx = jnp.clip(ei[0], 0, n_src - 1)
+            d_idx = jnp.clip(ei[1], 0, n_dst - 1)
+            w_att = self.param(f"w_att_{as_str(et)}",
+                               nn.initializers.glorot_uniform(), (h, d, d))
+            w_msg = self.param(f"w_msg_{as_str(et)}",
+                               nn.initializers.glorot_uniform(), (h, d, d))
+            mu = self.param(f"mu_{as_str(et)}", nn.initializers.ones, (h,))
+            ks = K[src_t][s_idx]                       # [E, h, d]
+            qd = Q[dst_t][d_idx]
+            score = jnp.einsum("ehd,hdc,ehc->eh", ks, w_att, qd)
+            score = score * mu / jnp.sqrt(jnp.asarray(d, score.dtype))
+            msg = jnp.einsum("ehd,hdc->ehc", V[src_t][s_idx], w_msg)
+            grouped.setdefault(dst_t, []).append((score, msg, d_idx, mask))
+
+        out = {}
+        for t, items in grouped.items():
+            n_t = x[t].shape[0]
+            # Joint two-pass softmax across every edge type ending in t:
+            # shared per-(node, head) max, then shared denominator.
+            m = jnp.full((n_t + 1, h), -jnp.inf)
+            for score, _, d_idx, mask in items:
+                seg = jnp.where(mask, d_idx, n_t)
+                m = jnp.maximum(m, jax.ops.segment_max(
+                    jnp.where(mask[:, None], score, -jnp.inf), seg,
+                    num_segments=n_t + 1))
+            m = jnp.where(jnp.isfinite(m), m, 0)
+            denom = jnp.zeros((n_t + 1, h))
+            num = jnp.zeros((n_t + 1, h, d))
+            for score, msg, d_idx, mask in items:
+                seg = jnp.where(mask, d_idx, n_t)
+                ex = jnp.where(mask[:, None], jnp.exp(score - m[seg]), 0)
+                denom = denom + jax.ops.segment_sum(
+                    ex, seg, num_segments=n_t + 1)
+                num = num + jax.ops.segment_sum(
+                    ex[:, :, None] * msg, seg, num_segments=n_t + 1)
+            agg = (num / jnp.maximum(denom, 1e-16)[:, :, None])[:n_t]
+            # Observable invariant (flax intermediates): the normalized
+            # attention mass per destination — 1 for nodes with >= 1
+            # incoming edge ACROSS ALL edge types jointly, 0 otherwise.
+            att_sum = jnp.zeros((n_t + 1, h))
+            for score, _, d_idx, mask in items:
+                seg = jnp.where(mask, d_idx, n_t)
+                ex = jnp.where(mask[:, None], jnp.exp(score - m[seg]), 0)
+                att_sum = att_sum + jax.ops.segment_sum(
+                    ex / jnp.maximum(denom, 1e-16)[seg], seg,
+                    num_segments=n_t + 1)
+            self.sow("intermediates", f"att_weight_sum_{t}", att_sum[:n_t])
+            a_out = nn.Dense(self.out_features, name=f"a_{t}")(
+                nn.gelu(agg.reshape(n_t, h * d)))
+            gate = self.param(f"skip_{t}", nn.initializers.ones, ())
+            out[t] = x[t] + jax.nn.sigmoid(gate) * a_out
+        # untouched destination types pass through
+        return {t: out.get(t, x[t]) for t in x}
+
+
+class HGT(nn.Module):
+    """Multi-layer HGT with per-type input projections and a target head
+    (the ``train_hgt_mag.py`` configuration of the reference examples)."""
+    edge_types: Sequence[Tuple[str, str, str]]
+    hidden_features: int
+    out_features: int
+    target_type: str
+    num_layers: int = 2
+    heads: int = 2
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: Dict[str, jnp.ndarray], edge_index, edge_mask, *,
+                 train: bool = False):
+        h = {t: nn.Dense(self.hidden_features, name=f"in_{t}")(v)
+             for t, v in x.items()}
+        for i in range(self.num_layers):
+            h = HGTConv(self.edge_types, self.hidden_features,
+                        heads=self.heads, name=f"layer{i}")(
+                h, edge_index, edge_mask)
+            if train:
+                h = {t: nn.Dropout(self.dropout_rate,
+                                   deterministic=False)(v)
+                     for t, v in h.items()}
+        return nn.Dense(self.out_features,
+                        name="head")(h[self.target_type])
