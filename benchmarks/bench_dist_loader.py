@@ -1,0 +1,155 @@
+"""Distributed-loader throughput bench.
+
+Mirrors the reference's ``benchmarks/api/bench_dist_neighbor_loader.py``
+(:26-83): per-epoch loader wall time + batches/s + sampled edges/s for
+the worker-mode ``DistNeighborLoader`` (mp sampling subprocesses feeding
+the trainer over the shm ring) and, separately, the in-jit mesh sampler
+(``DistNeighborSampler`` over the 8-virtual-device CPU mesh — the path
+that runs over ICI on a real pod).
+
+On this container both run on CPU, so the numbers are **code-path
+characterisation** (pipeline overheads, serialization, ring throughput),
+not TPU speed — the honest framing BASELINE.md uses for config 5.
+
+Prints one JSON line per mode.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_bench_dataset(n=20000, deg=8, dim=64, seed=0):
+    """Top-level so mp spawn workers can pickle + rebuild it."""
+    from glt_tpu.data import Dataset
+
+    rng = np.random.default_rng(seed)
+    src = np.repeat(np.arange(n), deg)
+    dst = rng.integers(0, n, n * deg)
+    feat = rng.normal(size=(n, dim)).astype(np.float32)
+    labels = (np.arange(n) % 16).astype(np.int32)
+    return (Dataset()
+            .init_graph(np.stack([src, dst]), graph_mode="HOST",
+                        num_nodes=n)
+            .init_node_features(feat)
+            .init_node_labels(labels))
+
+
+def bench_worker_mode(args):
+    from glt_tpu.distributed import DistNeighborLoader, MpSamplingWorkerOptions
+
+    loader = DistNeighborLoader(
+        args.fanout, np.arange(args.num_seeds), batch_size=args.batch_size,
+        dataset_builder=build_bench_dataset, builder_args=(),
+        worker_options=MpSamplingWorkerOptions(
+            num_workers=args.workers,
+            channel_capacity_bytes=256 << 20),
+        last_hop_dedup=args.last_hop_dedup)
+    try:
+        for _ in loader:        # warm epoch: worker startup + compiles
+            pass
+        t0 = time.perf_counter()
+        batches = edges = 0
+        for batch in loader:
+            batches += 1
+            edges += int(np.asarray(batch.edge_mask).sum())
+        dt = time.perf_counter() - t0
+    finally:
+        loader.shutdown()
+    print(json.dumps({
+        "metric": "dist_loader_worker_mode_epoch",
+        "value": round(dt, 3), "unit": "s",
+        "batches_per_s": round(batches / dt, 2),
+        "m_edges_per_s": round(edges / dt / 1e6, 3),
+        "num_workers": args.workers, "batch_size": args.batch_size,
+        "last_hop_dedup": args.last_hop_dedup,
+        "note": "cpu code-path characterisation",
+    }))
+
+
+def bench_mesh_sampler(args):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from glt_tpu.parallel import DistNeighborSampler, shard_graph
+
+    n_dev = min(8, len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("shard",))
+    ds = build_bench_dataset()
+    sg = shard_graph(ds.get_graph().topo, n_dev)
+    samp = DistNeighborSampler(sg, mesh, num_neighbors=args.fanout,
+                               batch_size=args.batch_size,
+                               last_hop_dedup=args.last_hop_dedup)
+    rng = np.random.default_rng(0)
+    n = ds.get_graph().num_nodes
+    seed_batches = [
+        jnp.asarray(rng.integers(0, n, (n_dev, args.batch_size))
+                    .astype(np.int32))
+        for _ in range(args.iters + 2)]
+    acc = jax.jit(lambda tot, e: tot + e.sum())
+    tot = jnp.zeros((), jnp.int32)
+    for i in range(2):
+        tot = acc(tot, samp.sample_from_nodes(
+            seed_batches[i]).num_sampled_edges)
+    int(tot)
+    tot = jnp.zeros((), jnp.int32)
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        tot = acc(tot, samp.sample_from_nodes(
+            seed_batches[2 + i]).num_sampled_edges)
+    edges = int(tot)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "dist_mesh_sampler_throughput",
+        "value": round(edges / dt / 1e6, 3), "unit": "M sampled edges/s",
+        "devices": n_dev, "batch_size": args.batch_size,
+        "batches_per_s": round(args.iters * n_dev / dt, 2),
+        "last_hop_dedup": args.last_hop_dedup,
+        "note": "virtual CPU mesh unless run on a pod",
+    }))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--modes", nargs="+",
+                    default=["worker", "mesh"],
+                    choices=["worker", "mesh"])
+    ap.add_argument("--fanout", type=int, nargs="+", default=[10, 5])
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--num-seeds", type=int, default=4096)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--iters", type=int, default=10)
+    # Default True = the library's default exact semantics; pass
+    # --no-last-hop-dedup to bench the leaf-block fast mode (reported
+    # separately in BASELINE.md).
+    ap.add_argument("--last-hop-dedup",
+                    action=argparse.BooleanOptionalAction, default=True)
+    ap.add_argument("--platform", default="cpu",
+                    help="'cpu' (default; 8 virtual devices for the mesh "
+                         "mode) or '' for the ambient platform — the axon "
+                         "sitecustomize hook overrides JAX_PLATFORMS, so "
+                         "the config value must be set in-process")
+    args = ap.parse_args()
+    if args.platform:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        os.environ["JAX_PLATFORMS"] = args.platform
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    if "worker" in args.modes:
+        bench_worker_mode(args)
+    if "mesh" in args.modes:
+        bench_mesh_sampler(args)
+
+
+if __name__ == "__main__":
+    main()

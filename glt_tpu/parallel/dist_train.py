@@ -47,12 +47,16 @@ def make_dist_train_step(
     batch_size: int,
     axis_name: str = "shard",
     frontier_cap: Optional[int] = None,
+    last_hop_dedup: bool = True,
 ):
     """Build ``step(state, seeds [S, B], key) -> (state, loss, acc)``.
 
     ``seeds`` carries one seed batch per shard (the per-rank disjoint seed
     split of dist_train_sage_supervised.py:76); params/opt state are
     replicated; gradients are ``pmean``-ed across the mesh.
+    ``last_hop_dedup=False`` selects the leaf-block final hop (see
+    NeighborSampler) — loss/acc are over seed rows, which stay in the
+    compact interior prefix, so the objective is unchanged.
     """
     gspec = P(axis_name)
 
@@ -64,7 +68,8 @@ def make_dist_train_step(
 
         out = dist_sample_multi_hop(
             indptr, indices, edge_ids, seeds, key, num_neighbors,
-            g.nodes_per_shard, g.num_shards, axis_name, frontier_cap)
+            g.nodes_per_shard, g.num_shards, axis_name, frontier_cap,
+            last_hop_dedup=last_hop_dedup)
         x = exchange_gather(out.node, rows, f.nodes_per_shard,
                             f.num_shards, axis_name)
         y = exchange_gather(out.node, labels_blk[:, None].astype(jnp.int32),
