@@ -2,6 +2,8 @@ from .dataset import Dataset
 from .feature import Feature
 from .graph import Graph
 from .reorder import sort_by_in_degree
+from .shared import SharedArray, attach_dataset, share_dataset
 from .topology import CSRTopo
 
-__all__ = ["Dataset", "Feature", "Graph", "CSRTopo", "sort_by_in_degree"]
+__all__ = ["Dataset", "Feature", "Graph", "CSRTopo", "SharedArray",
+           "attach_dataset", "share_dataset", "sort_by_in_degree"]

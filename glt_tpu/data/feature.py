@@ -124,17 +124,21 @@ class Feature:
         if self._id2index_np is not None:
             idx = self._id2index_np[idx]
         is_hot = idx < self._hot_count
-        # Device gather for the hot rows, host gather for the cold rows.
-        hot_rows = jnp.take(self._hot,
-                            jnp.asarray(np.where(is_hot, idx, 0), jnp.int32),
-                            axis=0, mode="clip")
         cold_np = np.take(self._cold,
                           np.clip(np.where(is_hot, 0, idx - self._hot_count),
                                   0, max(self._cold.shape[0] - 1, 0)),
                           axis=0)
         cold_rows = jnp.asarray(cold_np, self.dtype)
-        mask = jnp.asarray(is_hot & valid)[:, None]
         vmask = jnp.asarray(valid)[:, None]
+        if self._hot_count == 0:
+            # Fully host-resident (split_ratio == 0, e.g. a shared-memory
+            # attach in a sampling worker): no device hot tier to gather.
+            return jnp.where(vmask, cold_rows, 0)
+        # Device gather for the hot rows, host gather for the cold rows.
+        hot_rows = jnp.take(self._hot,
+                            jnp.asarray(np.where(is_hot, idx, 0), jnp.int32),
+                            axis=0, mode="clip")
+        mask = jnp.asarray(is_hot & valid)[:, None]
         return jnp.where(mask, hot_rows, jnp.where(vmask, cold_rows, 0))
 
     def __getitem__(self, ids) -> jnp.ndarray:

@@ -61,6 +61,26 @@ class CSRTopo:
             None if edge_weights is None else np.asarray(edge_weights)[perm]
         )
 
+    @classmethod
+    def from_csr_arrays(
+        cls,
+        indptr: np.ndarray,
+        indices: np.ndarray,
+        edge_ids: Optional[np.ndarray] = None,
+        edge_weights: Optional[np.ndarray] = None,
+    ) -> "CSRTopo":
+        """Install finished CSR arrays directly — zero-copy, no COO
+        round-trip.  The arrays are adopted as-is (callers guarantee CSR
+        validity); used by the shared-memory attach path and benches."""
+        t = cls.__new__(cls)
+        t._indptr = np.asarray(indptr)
+        t._indices = np.asarray(indices)
+        t._edge_ids = (np.arange(t._indices.shape[0], dtype=np.int64)
+                       if edge_ids is None else np.asarray(edge_ids))
+        t._edge_weights = (None if edge_weights is None
+                           else np.asarray(edge_weights))
+        return t
+
     @property
     def indptr(self) -> np.ndarray:
         return self._indptr

@@ -117,6 +117,32 @@ def test_mp_worker_mode():
         loader.shutdown()
 
 
+def test_mp_worker_mode_shared_memory_dataset():
+    """Workers attach the trainer's shm dataset instead of rebuilding it
+    (the reference's IPC-shared Graph/Feature, data/graph.py:190-239 +
+    feature.py:208-258): same batches, one physical copy of graph +
+    features across the worker fleet."""
+    from glt_tpu.data import attach_dataset, share_dataset
+
+    handle = share_dataset(build_ring_dataset())
+    loader = DistNeighborLoader(
+        [2, 2], np.arange(N), batch_size=6,
+        dataset_builder=attach_dataset, builder_args=(handle,),
+        worker_options=MpSamplingWorkerOptions(num_workers=2,
+                                               channel_capacity_bytes=1 << 20))
+    try:
+        for epoch in range(2):
+            seen = []
+            for batch in loader:
+                check_batch(batch)
+                seen.extend(
+                    np.asarray(batch.batch)[:batch.batch_size].tolist())
+            assert sorted(seen) == list(range(N))
+    finally:
+        loader.shutdown()
+        handle.unlink()
+
+
 def test_mp_link_loader():
     """Worker-mode link loader (cf. test_dist_link_loader.py): positive
     seed edges resolve to true ring successors through the relabeling,

@@ -108,3 +108,61 @@ class TestDataset:
         assert ds.get_node_types() == ["item", "user"]
         assert len(ds.get_edge_types()) == 2
         assert ds.get_graph(("user", "likes", "item")).num_nodes == 2
+
+
+class TestSharedDataset:
+    """share_dataset/attach_dataset round-trip (the reference's IPC-shared
+    Graph/Feature, data/graph.py:190-239 + feature.py:208-258)."""
+
+    def _dataset(self):
+        n = 16
+        src = np.repeat(np.arange(n), 2)
+        dst = np.concatenate([[(i + 1) % n, (i + 2) % n] for i in range(n)])
+        feat = np.arange(n, dtype=np.float32)[:, None] * np.ones((1, 4),
+                                                                 np.float32)
+        efeat = np.arange(2 * n, dtype=np.float32)[:, None]
+        return (Dataset()
+                .init_graph(np.stack([src, dst]), graph_mode="HOST",
+                            num_nodes=n)
+                .init_node_features(feat, dtype=jnp.bfloat16)
+                .init_edge_features(efeat)
+                .init_node_labels(np.arange(n, dtype=np.int32) % 3))
+
+    def test_roundtrip_zero_copy(self):
+        import pickle
+
+        from glt_tpu.data import attach_dataset, share_dataset
+
+        ds = self._dataset()
+        h = share_dataset(ds)
+        try:
+            ds2 = attach_dataset(pickle.loads(pickle.dumps(h)))
+            np.testing.assert_array_equal(ds2.get_graph().topo.indices,
+                                          ds.get_graph().topo.indices)
+            # physically the same pages
+            shm_view = h.topos[None][1].array
+            orig = shm_view[0]
+            shm_view[0] = 77
+            assert ds2.get_graph().topo.indices[0] == 77
+            shm_view[0] = orig
+            # node features: dtype survives the attach (bf16 cast)
+            f2 = ds2.get_node_feature()
+            rows = f2.gather(np.array([0, 3, -1, 7]))
+            assert rows.dtype == jnp.bfloat16
+            rows = np.asarray(rows, np.float32)
+            assert rows[0, 0] == 0 and rows[1, 0] == 3 and rows[3, 0] == 7
+            assert (rows[2] == 0).all()
+            # edge features shared too
+            er = np.asarray(ds2.get_edge_feature().gather(np.array([5])))
+            assert er[0, 0] == 5
+            # labels
+            assert ds2.get_node_label()[5] == 5 % 3
+        finally:
+            h.unlink()
+
+    def test_unlink_idempotent(self):
+        from glt_tpu.data import share_dataset
+
+        h = share_dataset(self._dataset())
+        h.unlink()
+        h.unlink()  # second call must be a no-op
