@@ -61,9 +61,13 @@ class Graph:
         # tracers would be cached on the object and leak.
         with jax.ensure_compile_time_eval():
             as_arr = jnp.asarray if self.mode == "DEVICE" else np.asarray
-            self._indptr = as_arr(self.topo.indptr.astype(np.int32))
-            self._indices = as_arr(self.topo.indices.astype(np.int32))
-            host_eids = self.topo.edge_ids.astype(np.int32)
+            # copy=False: already-int32 arrays (e.g. shared-memory
+            # attaches) stay views instead of per-process copies.
+            self._indptr = as_arr(self.topo.indptr.astype(np.int32,
+                                                          copy=False))
+            self._indices = as_arr(self.topo.indices.astype(np.int32,
+                                                            copy=False))
+            host_eids = self.topo.edge_ids.astype(np.int32, copy=False)
             self._edge_ids = as_arr(host_eids)
             # Trivial (positional) edge ids need no gather at sample time:
             # the sampler can emit CSR positions directly, skipping one

@@ -77,8 +77,11 @@ class SharedArray:
         return self._shm.name
 
     def __reduce__(self):
+        # Ship the np.dtype object itself: dtype.str does not round-trip
+        # ml_dtypes (np.dtype(bfloat16).str == '<V2', which reconstructs
+        # as void); np.dtype instances pickle correctly for all of them.
         return (SharedArray.attach,
-                (self._shm.name, self.shape, self.dtype.str))
+                (self._shm.name, self.shape, self.dtype))
 
     def close(self) -> None:
         """Unmap; the owner also frees the segment."""
@@ -173,11 +176,23 @@ def share_dataset(ds: Dataset) -> DatasetHandle:
     process exit."""
     hetero = ds.is_hetero
     graphs = ds.graph if hetero else {None: ds.graph}
+
+    def narrow(arr):
+        # Graph.lazy_init consumes int32; sharing int64 would make every
+        # worker's astype materialize a private copy of the topology.
+        # Narrow once here (values above int32 range would already be
+        # unrepresentable in Graph's device arrays).
+        arr = np.asarray(arr)
+        if (arr.dtype == np.int64
+                and (arr.size == 0 or arr.max() < np.iinfo(np.int32).max)):
+            return arr.astype(np.int32)
+        return arr
+
     topos = {}
     for k, g in graphs.items():
         t = g.topo
-        topos[k] = (_share(t.indptr), _share(t.indices),
-                    _share(t.edge_ids), _share(t.edge_weights))
+        topos[k] = (_share(narrow(t.indptr)), _share(narrow(t.indices)),
+                    _share(narrow(t.edge_ids)), _share(t.edge_weights))
 
     nl = ds.node_labels
     labels_in = nl if isinstance(nl, dict) else {None: nl}
