@@ -30,8 +30,10 @@ def main():
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--fanout", type=int, nargs="+", default=[5, 5])
-    ap.add_argument("--last-hop-dedup", action="store_true",
-                    help="exact final-hop dedup (default: fast leaf block)")
+    ap.add_argument("--last-hop-dedup", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="exact final-hop dedup (default); "
+                         "--no-last-hop-dedup opts into the fast leaf block")
     args = ap.parse_args()
 
     ds, train_idx, classes = synthetic_mag(scale=args.scale)

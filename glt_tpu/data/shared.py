@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import atexit
 import secrets
+import threading
 from multiprocessing import shared_memory
 from typing import Optional
 
@@ -40,6 +41,9 @@ from .dataset import Dataset
 from .feature import Feature
 from .graph import Graph
 from .topology import CSRTopo
+
+# Serializes the pre-3.13 register-suppression window in SharedArray.attach.
+_attach_lock = threading.Lock()
 
 
 class SharedArray:
@@ -69,8 +73,39 @@ class SharedArray:
 
     @classmethod
     def attach(cls, name: str, shape, dtype) -> "SharedArray":
-        return cls(shared_memory.SharedMemory(name=name), shape, dtype,
-                   owner=False)
+        try:
+            # 3.13+: do not register with this process's resource_tracker
+            # — attachers must never unlink the creator's segment.
+            shm = shared_memory.SharedMemory(name=name, track=False)
+        except TypeError:
+            # Pre-3.13 SharedMemory always registers attaches with the
+            # resource tracker, so an attacher's exit would unlink the
+            # creator's segment (and spam leaked-shm warnings).  Suppress
+            # the register call itself — unregistering *after* the fact
+            # would instead delete the creator's entry whenever both
+            # processes share one tracker daemon (mp children do).  The
+            # creator owns cleanup (handle.unlink / its atexit finalizer).
+            from multiprocessing import resource_tracker
+
+            seg = name if name.startswith("/") else "/" + name
+
+            with _attach_lock:
+                orig = resource_tracker.register
+
+                def _skip_ours(rname, rtype, _orig=orig, _seg=seg):
+                    # Scoped: only this segment's registration is dropped;
+                    # unrelated resources other threads create during the
+                    # window keep normal tracking.
+                    if rtype == "shared_memory" and rname == _seg:
+                        return None
+                    return _orig(rname, rtype)
+
+                resource_tracker.register = _skip_ours
+                try:
+                    shm = shared_memory.SharedMemory(name=name)
+                finally:
+                    resource_tracker.register = orig
+        return cls(shm, shape, dtype, owner=False)
 
     @property
     def name(self) -> str:
@@ -242,8 +277,12 @@ def attach_dataset(handle: DatasetHandle,
     if handle.hetero:
         ds.node_features = nfeats or None
         ds.edge_features = efeats or None
-        ds.node_labels = {k: v.array for k, v in handle.labels.items()
-                          if v is not None}
+        lab = {k: v.array for k, v in handle.labels.items()
+               if v is not None}
+        # Preserve the original label state: a hetero dataset shared with
+        # node_labels=None must attach as None, not {} (the homogeneous
+        # branch below already does).
+        ds.node_labels = lab or None
     else:
         ds.node_features = nfeats.get(None)
         ds.edge_features = efeats.get(None)

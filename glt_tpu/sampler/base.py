@@ -137,6 +137,14 @@ class SamplerOutput:
     * ``num_sampled_nodes`` / ``num_sampled_edges``: per-hop valid counts
       (device int32 vectors, lengths num_hops+1 / num_hops).
     * ``metadata``: dict of extra arrays (edge_label_index, labels, ...).
+
+    Leaf-block layout caveat: with ``last_hop_dedup=False`` (see
+    :class:`~glt_tpu.sampler.neighbor_sampler.NeighborSampler`) the
+    final-hop nodes are stored in a *leaf block* at a static offset
+    ``max_nodes - last_width * last_fanout``, not appended to the compact
+    interior prefix.  Valid rows must then be selected with ``node_mask``
+    — PyG-style ``cumsum(num_sampled_nodes)`` trimming over ``node`` would
+    mis-slice.  Seed rows always stay in the compact hop-0 prefix.
     """
     node: jnp.ndarray
     row: jnp.ndarray
