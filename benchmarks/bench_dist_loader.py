@@ -72,6 +72,27 @@ def bench_worker_mode(args):
     }))
 
 
+def exchange_bytes_per_shard(batch_size, fanouts, num_shards,
+                             load_factor=None, frontier_cap=None):
+    """Analytic per-shard per-batch all-to-all payload (bytes).
+
+    Each hop moves one id leg ``[S, cap]`` out and two result legs
+    ``[S, cap, fanout]`` (neighbors + edge ids) back, all int32.  With
+    ``load_factor`` α the per-owner cap shrinks from the full frontier
+    width to ``ceil(α*w/S)`` (dist_sampler.exchange_one_hop).
+    """
+    from glt_tpu.parallel.dist_sampler import bounded_remote_cap
+    from glt_tpu.sampler.neighbor_sampler import hop_widths
+
+    widths = hop_widths(batch_size, list(fanouts), frontier_cap)
+    total = 0
+    for w, f in zip(widths, fanouts):
+        cap = (w if load_factor is None
+               else bounded_remote_cap(w, load_factor, num_shards))
+        total += num_shards * cap * 4 * (1 + 2 * f)
+    return total
+
+
 def bench_mesh_sampler(args):
     import jax
     import jax.numpy as jnp
@@ -83,34 +104,62 @@ def bench_mesh_sampler(args):
     mesh = Mesh(np.array(jax.devices()[:n_dev]), ("shard",))
     ds = build_bench_dataset()
     sg = shard_graph(ds.get_graph().topo, n_dev)
-    samp = DistNeighborSampler(sg, mesh, num_neighbors=args.fanout,
-                               batch_size=args.batch_size,
-                               last_hop_dedup=args.last_hop_dedup)
     rng = np.random.default_rng(0)
     n = ds.get_graph().num_nodes
+    # Shard-local seed batches (the split_seeds training layout): hop 0
+    # is exchange-free under the bounded path.
+    c = sg.nodes_per_shard
     seed_batches = [
-        jnp.asarray(rng.integers(0, n, (n_dev, args.batch_size))
-                    .astype(np.int32))
+        jnp.asarray(np.stack([
+            rng.integers(s * c, min((s + 1) * c, n), args.batch_size)
+            for s in range(n_dev)]).astype(np.int32))
         for _ in range(args.iters + 2)]
     acc = jax.jit(lambda tot, e: tot + e.sum())
-    tot = jnp.zeros((), jnp.int32)
-    for i in range(2):
-        tot = acc(tot, samp.sample_from_nodes(
-            seed_batches[i]).num_sampled_edges)
-    int(tot)
-    tot = jnp.zeros((), jnp.int32)
-    t0 = time.perf_counter()
-    for i in range(args.iters):
-        tot = acc(tot, samp.sample_from_nodes(
-            seed_batches[2 + i]).num_sampled_edges)
-    edges = int(tot)
-    dt = time.perf_counter() - t0
+
+    def run(alpha):
+        samp = DistNeighborSampler(sg, mesh, num_neighbors=args.fanout,
+                                   batch_size=args.batch_size,
+                                   last_hop_dedup=args.last_hop_dedup,
+                                   exchange_load_factor=alpha)
+        tot = jnp.zeros((), jnp.int32)
+        dropped = 0
+        for i in range(2):
+            tot = acc(tot, samp.sample_from_nodes(
+                seed_batches[i]).num_sampled_edges)
+        int(tot)
+        tot = jnp.zeros((), jnp.int32)
+        t0 = time.perf_counter()
+        for i in range(args.iters):
+            out = samp.sample_from_nodes(seed_batches[2 + i])
+            tot = acc(tot, out.num_sampled_edges)
+            if alpha is not None:
+                dropped += int(np.asarray(
+                    out.metadata["exchange_dropped"]).sum())
+        edges = int(tot)
+        dt = time.perf_counter() - t0
+        return edges, dt, dropped
+
+    edges, dt, _ = run(None)
+    alpha = args.exchange_load_factor
+    b_edges, b_dt, b_dropped = run(alpha)
+    full_mb = exchange_bytes_per_shard(args.batch_size, args.fanout,
+                                       n_dev) / 1e6
+    bounded_mb = exchange_bytes_per_shard(args.batch_size, args.fanout,
+                                          n_dev, alpha) / 1e6
     print(json.dumps({
         "metric": "dist_mesh_sampler_throughput",
         "value": round(edges / dt / 1e6, 3), "unit": "M sampled edges/s",
         "devices": n_dev, "batch_size": args.batch_size,
         "batches_per_s": round(args.iters * n_dev / dt, 2),
         "last_hop_dedup": args.last_hop_dedup,
+        "bounded_m_edges_per_s": round(b_edges / b_dt / 1e6, 3),
+        "bounded_batches_per_s": round(args.iters * n_dev / b_dt, 2),
+        "exchange_load_factor": alpha,
+        "exchange_mb_per_shard_batch_full": round(full_mb, 3),
+        "exchange_mb_per_shard_batch_bounded": round(bounded_mb, 3),
+        "exchange_reduction_x": round(full_mb / max(bounded_mb, 1e-9), 2),
+        "bounded_dropped_requests": b_dropped,
+        "bounded_sampled_edges_frac": round(b_edges / max(edges, 1), 4),
         "note": "virtual CPU mesh unless run on a pod",
     }))
 
@@ -130,6 +179,9 @@ def main():
     # separately in BASELINE.md).
     ap.add_argument("--last-hop-dedup",
                     action=argparse.BooleanOptionalAction, default=True)
+    ap.add_argument("--exchange-load-factor", type=float, default=2.0,
+                    help="alpha for the capacity-bounded exchange "
+                         "comparison in mesh mode")
     ap.add_argument("--platform", default="cpu",
                     help="'cpu' (default; 8 virtual devices for the mesh "
                          "mode) or '' for the ambient platform — the axon "

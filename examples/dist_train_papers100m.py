@@ -18,6 +18,25 @@ fraction of 111M).  On a dev box:
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python examples/dist_train_papers100m.py --devices 8 --scale 2e-5
+
+**Multi-host**: with ``GLT_NUM_PROCESSES`` set, every process joins one
+global mesh (``glt_tpu.parallel.multihost``), process 0 partitions, and
+each process loads ONLY its own partitions (``DistDataset.load(mesh=...)``)
+— the reference's per-machine partition loading (dist_dataset.py:77-164)
+over jax.distributed instead of torch RPC.  Emulate a 2-host x 4-chip pod
+on a dev box with:
+
+    scripts/run_multihost_example.sh 2 4      # procs x devices-per-proc
+
+or manually, per process i in {0, 1}:
+
+    GLT_NUM_PROCESSES=2 GLT_PROCESS_ID=$i \
+    GLT_COORDINATOR_ADDR=localhost:9876 \
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 JAX_PLATFORMS=cpu \
+        python examples/dist_train_papers100m.py --devices 8 --scale 2e-5
+
+On a real v5e-16 (4 hosts x 4 chips) drop the env overrides: jax
+auto-detects the fleet from the TPU metadata server.
 """
 import argparse
 import os
@@ -45,6 +64,13 @@ def main():
     ap.add_argument("--part-dir", default=None,
                     help="reuse an existing partition dir")
     args = ap.parse_args()
+
+    multihost_mode = int(os.environ.get("GLT_NUM_PROCESSES", "1")) > 1
+    if multihost_mode:
+        # Must run before anything touches the XLA backend.
+        from glt_tpu.parallel import multihost
+
+        multihost.initialize()
 
     import jax
     import jax.numpy as jnp
@@ -82,9 +108,30 @@ def main():
     train_idx = rng.choice(n, max(n // 10, args.devices * args.batch_size),
                            replace=False)
 
+    is_main = (not multihost_mode) or jax.process_index() == 0
     part_dir = args.part_dir or os.path.join(
         tempfile.gettempdir(), f"glt_papers_parts_{n}_{args.devices}")
-    if not os.path.exists(os.path.join(part_dir, "META.json")):
+    done_file = os.path.join(part_dir, "_DONE")
+    # Pre-existing partition dirs (older runs / the standalone
+    # partitioner) have META.json but no sentinel: adopt, don't redo.
+    if (is_main and not os.path.exists(done_file)
+            and os.path.exists(os.path.join(part_dir, "META.json"))):
+        with open(done_file, "w") as fh:
+            fh.write("ok")
+    if multihost_mode and not is_main:
+        # Only process 0 partitions; everyone else waits for the sentinel
+        # (the reference's rank-0 offline partition step).  NOTE:
+        # part_dir must be on a filesystem all hosts share (NFS/GCS
+        # mount) — on a real pod, pass --part-dir accordingly.
+        deadline = time.monotonic() + 600
+        while not os.path.exists(done_file):
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"partitioning never finished: {part_dir} — on a "
+                    f"multi-host run, --part-dir must be on a filesystem "
+                    f"shared by every host")
+            time.sleep(0.5)
+    elif not os.path.exists(done_file):
         t0 = time.perf_counter()
         # Hotness from the sampler's access-probability estimate, one
         # vector per trainer rank (partition_ogbn_dataset.py flow).
@@ -101,29 +148,45 @@ def main():
         # Total access probability also orders each shard's HBM prefix.
         np.save(os.path.join(part_dir, "hotness.npy"),
                 np.sum(probs, axis=0))
+        with open(done_file, "w") as fh:
+            fh.write("ok")
         print(f"partitioned {n} nodes / {edge_index.shape[1]} edges "
               f"into {args.devices} parts in "
               f"{time.perf_counter() - t0:.1f}s -> {part_dir}")
 
+    if multihost_mode:
+        from glt_tpu.parallel import multihost
+
+        mesh = multihost.global_mesh()
+        if mesh.devices.size != args.devices:
+            raise SystemExit(
+                f"--devices {args.devices} != global device count "
+                f"{mesh.devices.size}")
+    else:
+        from examples.datasets import ensure_cpu_devices
+
+        devices = ensure_cpu_devices(args.devices)
+        if len(devices) < args.devices:
+            raise SystemExit(
+                f"need {args.devices} devices, have {len(devices)}")
+        mesh = Mesh(np.array(devices[: args.devices]), ("shard",))
+
     # HBM-prefix ordering by the saved total access probability (falls
-    # back to in-degree inside load() when absent).
+    # back to in-degree inside load() when absent).  In multihost mode
+    # every process loads ONLY its own partitions and feeds them into the
+    # process-spanning global arrays.
     hot_file = os.path.join(part_dir, "hotness.npy")
     hotness = np.load(hot_file) if os.path.exists(hot_file) else None
     ds = DistDataset.load(part_dir, hot_ratio=args.hot_ratio, labels=labels,
-                          hotness=hotness)
+                          hotness=hotness,
+                          mesh=mesh if multihost_mode else None)
     tiered = args.hot_ratio < 1.0
     hot_desc = (f"{ds.feature.hot_per_shard}/{ds.feature.nodes_per_shard}"
                 if tiered else "all (no host tier)")
-    print(f"loaded: {ds.graph.num_shards} shards x "
-          f"{ds.relabel.nodes_per_shard} nodes, hot rows/shard = {hot_desc}")
-
-    from examples.datasets import ensure_cpu_devices
-
-    devices = ensure_cpu_devices(args.devices)
-    if len(devices) < args.devices:
-        raise SystemExit(f"need {args.devices} devices, have {len(devices)}")
-    devices = devices[: args.devices]
-    mesh = Mesh(np.array(devices), ("shard",))
+    if is_main:
+        print(f"loaded: {ds.graph.num_shards} shards x "
+              f"{ds.relabel.nodes_per_shard} nodes, "
+              f"hot rows/shard = {hot_desc}")
 
     model = GraphSAGE(hidden_features=256, out_features=args.classes,
                       num_layers=len(args.fanout), dropout_rate=0.0)
@@ -146,10 +209,17 @@ def main():
                                     ds.labels, mesh, args.fanout,
                                     args.batch_size)
 
+        def feed(b):
+            if multihost_mode:
+                from glt_tpu.parallel import multihost
+
+                return multihost.feed_seeds(b, mesh)
+            return jnp.asarray(b)
+
         def run_epoch(state, batches, key):
             losses, accs = [], []
             for b in range(batches.shape[0]):
-                state, loss, acc = step(state, jnp.asarray(batches[b]),
+                state, loss, acc = step(state, feed(batches[b]),
                                         jax.random.fold_in(key, b))
                 losses.append(loss)
                 accs.append(acc)

@@ -19,7 +19,25 @@ import optax
 
 from examples.datasets import synthetic_products
 from glt_tpu.loader import NeighborLoader
-from glt_tpu.models import GraphSAGE, create_train_state, make_train_step
+from glt_tpu.models import (
+    GraphSAGE,
+    create_train_state,
+    make_pipelined_train_step,
+    make_train_step,
+    run_pipelined_epoch,
+)
+from glt_tpu.sampler import NeighborSampler
+
+
+def seed_batches(train_idx, batch_size, rng):
+    """Shuffled [batch_size] seed chunks, trailing batch -1 padded."""
+    ids = train_idx[rng.permutation(train_idx.shape[0])]
+    for lo in range(0, ids.shape[0], batch_size):
+        chunk = ids[lo: lo + batch_size].astype(np.int32)
+        if chunk.shape[0] < batch_size:
+            chunk = np.pad(chunk, (0, batch_size - chunk.shape[0]),
+                           constant_values=-1)
+        yield chunk
 
 
 def main():
@@ -30,28 +48,60 @@ def main():
     ap.add_argument("--fanout", type=int, nargs="+", default=[15, 10, 5])
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--frontier-cap", type=int, default=8192)
+    # Fused "train k + sample k+1" single-program pipeline (default);
+    # --no-pipelined runs the two-program loader path.
+    ap.add_argument("--pipelined", action=argparse.BooleanOptionalAction,
+                    default=True)
     args = ap.parse_args()
 
     ds, train_idx = synthetic_products(scale=args.scale)
-    loader = NeighborLoader(ds, args.fanout, train_idx,
-                            batch_size=args.batch_size, shuffle=True,
-                            frontier_cap=args.frontier_cap)
-
     model = GraphSAGE(hidden_features=args.hidden, out_features=47,
                       num_layers=len(args.fanout))
     tx = optax.adam(1e-3)
-    first = next(iter(loader))
-    state = create_train_state(model, jax.random.PRNGKey(0), first, tx)
-    step = make_train_step(model, tx, batch_size=args.batch_size)
+
+    if args.pipelined:
+        sampler = NeighborSampler(ds.get_graph(), args.fanout,
+                                  batch_size=args.batch_size,
+                                  frontier_cap=args.frontier_cap,
+                                  with_edge=False)
+        feat = ds.get_node_feature()
+        labels = np.asarray(ds.get_node_label())
+        x0 = jax.numpy.zeros((sampler.node_capacity, feat.shape[1]),
+                             feat.dtype)
+        ei0 = jax.numpy.full((2, sampler.edge_capacity), -1, jax.numpy.int32)
+        m0 = jax.numpy.zeros((sampler.edge_capacity,), bool)
+        params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
+        from glt_tpu.models import TrainState
+        state = TrainState(params=params, opt_state=tx.init(params),
+                           step=jax.numpy.zeros((), jax.numpy.int32))
+        step, sample_first = make_pipelined_train_step(
+            model, tx, sampler, feat, labels, args.batch_size)
+        rng = np.random.default_rng(0)
+
+        def run_epoch(state, epoch):
+            return run_pipelined_epoch(
+                step, sample_first,
+                seed_batches(train_idx, args.batch_size, rng),
+                state, jax.random.PRNGKey(100 + epoch))
+    else:
+        loader = NeighborLoader(ds, args.fanout, train_idx,
+                                batch_size=args.batch_size, shuffle=True,
+                                frontier_cap=args.frontier_cap)
+        first = next(iter(loader))
+        state = create_train_state(model, jax.random.PRNGKey(0), first, tx)
+        step = make_train_step(model, tx, batch_size=args.batch_size)
+
+        def run_epoch(state, epoch):
+            losses, accs = [], []
+            for batch in loader:
+                state, loss, acc = step(state, batch)
+                losses.append(loss)
+                accs.append(acc)
+            return state, losses, accs
 
     for epoch in range(args.epochs):
         t0 = time.perf_counter()
-        n_batches, losses, accs = 0, [], []
-        for batch in loader:
-            state, loss, acc = step(state, batch)
-            losses.append(loss)
-            accs.append(acc)
-            n_batches += 1
+        state, losses, accs = run_epoch(state, epoch)
         # device_get is a true sync; block_until_ready does not
         # wait under the axon tunnel (see bench.py docstring).
         jax.device_get(losses[-1])
@@ -59,7 +109,7 @@ def main():
         print(f"epoch {epoch}: loss={float(np.mean(jax.device_get(losses))):.4f} "
               f"acc={float(np.mean(jax.device_get(accs))):.4f} "
               f"time={dt:.2f}s "
-              f"subgraphs/s={n_batches / dt:.1f}")
+              f"subgraphs/s={len(losses) / dt:.1f}")
 
 
 if __name__ == "__main__":

@@ -94,6 +94,8 @@ class DistDataset(NamedTuple):
         labels: Optional[np.ndarray] = None,
         hotness: Optional[np.ndarray] = None,
         dtype=None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        axis_name: str = "shard",
     ) -> "DistDataset":
         """Compose a saved partition dir into mesh-ready sharded arrays.
 
@@ -107,6 +109,14 @@ class DistDataset(NamedTuple):
           hotness: optional global ``[N]`` score ordering each partition's
             rows hottest-first; defaults to in-degree
             (``sort_by_in_degree``, reference data/reorder.py:18).
+          mesh: when given, load **per host**: each process reads only the
+            partitions backing its local mesh devices and feeds them into
+            process-spanning global arrays
+            (:mod:`~glt_tpu.parallel.multihost`) — the reference's "each
+            machine loads its own partition" (dist_dataset.py:77-164).
+            With in-degree hotness unavailable locally, pass ``hotness``
+            explicitly (the partitioner saves one) or rows keep partition
+            order.
         """
         import json
 
@@ -115,6 +125,15 @@ class DistDataset(NamedTuple):
         num_parts = int(meta["num_parts"])
         num_nodes = int(meta["num_nodes"])
         node_pb = np.load(os.path.join(root, "node_pb.npy"))
+
+        if mesh is not None:
+            if meta.get("edge_assign_strategy", "by_src") != "by_src":
+                raise ValueError(
+                    "per-host loading requires the by_src edge layout "
+                    "(each partition owns its sources' out-edges)")
+            return DistDataset._load_multihost(
+                root, num_parts, num_nodes, node_pb, hot_ratio, labels,
+                hotness, dtype, mesh, axis_name)
 
         # 1) gather every partition's edges + features (single-process
         #    emulation; per-host loads on a real pod).
@@ -162,6 +181,124 @@ class DistDataset(NamedTuple):
             lab_new = relabel_rows(np.asarray(labels), rel, fill=-1)
             lab = jnp.asarray(
                 lab_new.reshape(num_parts, rel.nodes_per_shard))
+
+        return DistDataset(graph=g, feature=feature, labels=lab,
+                           relabel=rel, num_parts=num_parts)
+
+    @staticmethod
+    def _load_multihost(root, num_parts, num_nodes, node_pb, hot_ratio,
+                        labels, hotness, dtype, mesh, axis_name):
+        """Per-host composition: local partitions -> global arrays.
+
+        Every host computes the (global) contiguous relabel from the small
+        ``node_pb``/``hotness`` files, loads only its own partitions'
+        edges + feature rows, builds its shard blocks, and assembles them
+        into process-spanning arrays.  No host materialises another
+        host's partition — the property that makes papers100M-scale
+        feeding possible on a pod.
+        """
+        from ..parallel import multihost
+        from ..parallel.sharding import ShardedGraph
+
+        if mesh.devices.size != num_parts:
+            raise ValueError(
+                f"mesh has {mesh.devices.size} devices but the partition "
+                f"dir holds {num_parts} partitions")
+        local = multihost.local_shard_range(mesh, axis_name)
+
+        # 1) local partitions only (edges + feature rows, original ids).
+        parts = []
+        local_max_e = 0
+        for p in local:
+            graph, node_feat, _, _, _, _ = load_partition(root, p)
+            parts.append((p, graph, node_feat))
+            local_max_e = max(local_max_e, int(graph.eids.shape[0]))
+
+        # In-degree hotness (the plain load()'s default) needs incoming
+        # edges, which may live in any partition: aggregate local
+        # bincounts across hosts.  Pass `hotness` explicitly to skip the
+        # O(N * processes) gather at papers100M scale.
+        if hotness is None:
+            local_deg = np.zeros(num_nodes, np.int64)
+            for _, graph, _ in parts:
+                local_deg += np.bincount(graph.edge_index[1],
+                                         minlength=num_nodes)
+            hotness = multihost.agree_sum(local_deg)
+        rel = contiguous_relabel(node_pb, hotness=hotness,
+                                 num_parts=num_parts)
+        c = rel.nodes_per_shard
+
+        # Relabeled per-partition CSR blocks + feature rows.
+        part_topos, part_feats = [], []
+        feat_dim, feat_dtype = None, None
+        for p, graph, node_feat in parts:
+            src, dst = graph.edge_index
+            nsrc = rel.old2new[src] - p * c
+            if nsrc.size and (nsrc.min() < 0 or nsrc.max() >= c):
+                raise ValueError(
+                    f"partition {p} holds edges whose sources it does not "
+                    f"own — not a by_src layout")
+            topo_p = CSRTopo(np.stack([nsrc, rel.old2new[dst]]),
+                             edge_ids=graph.eids, num_nodes=c)
+            part_topos.append(topo_p)
+            if node_feat is not None:
+                nloc = rel.old2new[node_feat.ids.astype(np.int64)] - p * c
+                part_feats.append((nloc, node_feat.feats))
+                feat_dim = node_feat.feats.shape[1]
+                feat_dtype = node_feat.feats.dtype
+            else:
+                part_feats.append(None)
+
+        # 2) pad to the globally-agreed edge width; assemble the graph.
+        max_e = multihost.agree_max(local_max_e)
+        k = len(part_topos)
+        ip = np.zeros((k, c + 1), np.int32)
+        ix = np.full((k, max_e), -1, np.int32)
+        ei = np.full((k, max_e), -1, np.int32)
+        for j, t in enumerate(part_topos):
+            ne = t.indices.shape[0]
+            ip[j] = t.indptr.astype(np.int32)
+            ix[j, :ne] = t.indices
+            ei[j, :ne] = t.edge_ids
+        g = ShardedGraph(
+            indptr=multihost.assemble_global(ip, mesh, axis_name),
+            indices=multihost.assemble_global(ix, mesh, axis_name),
+            edge_ids=multihost.assemble_global(ei, mesh, axis_name),
+            nodes_per_shard=c, num_nodes=num_parts * c,
+            num_shards=num_parts)
+
+        # 3) features: per-shard [c, d] blocks, hot prefix split per host.
+        feature = None
+        if feat_dim is not None:
+            h = (c if hot_ratio >= 1.0
+                 else min(c, max(1, int(round(c * float(hot_ratio))))))
+            out_dtype = feat_dtype if dtype is None else np.dtype(dtype)
+            hot = np.zeros((k, h, feat_dim), out_dtype)
+            cold = np.zeros((num_parts, c - h, feat_dim), feat_dtype)
+            for j, pf in enumerate(part_feats):
+                if pf is None:
+                    continue
+                nloc, rows = pf
+                blk = np.zeros((c, feat_dim), feat_dtype)
+                blk[nloc] = rows
+                hot[j] = blk[:h]
+                if c > h:
+                    cold[local.start + j] = blk[h:]
+            hot_arr = multihost.assemble_global(hot, mesh, axis_name)
+            if hot_ratio >= 1.0:
+                feature = ShardedFeature(rows=hot_arr, nodes_per_shard=c,
+                                         num_shards=num_parts)
+            else:
+                feature = TieredShardedFeature(
+                    hot=hot_arr, cold=cold, nodes_per_shard=c,
+                    hot_per_shard=h, num_shards=num_parts)
+
+        # 4) labels: whole-graph array (small) -> per-host shard slices.
+        lab = None
+        if labels is not None:
+            lab_new = relabel_rows(np.asarray(labels), rel, fill=-1)
+            lab_blk = lab_new.reshape(num_parts, c)[local.start: local.stop]
+            lab = multihost.assemble_global(lab_blk, mesh, axis_name)
 
         return DistDataset(graph=g, feature=feature, labels=lab,
                            relabel=rel, num_parts=num_parts)

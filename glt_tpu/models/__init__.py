@@ -7,7 +7,9 @@ from .train import (
     TrainState,
     create_train_state,
     make_eval_step,
+    make_pipelined_train_step,
     make_train_step,
+    run_pipelined_epoch,
     seed_cross_entropy,
 )
 
@@ -23,7 +25,9 @@ __all__ = [
     "TrainState",
     "create_train_state",
     "make_eval_step",
+    "make_pipelined_train_step",
     "make_train_step",
+    "run_pipelined_epoch",
     "scatter_mean",
     "scatter_sum",
     "seed_cross_entropy",

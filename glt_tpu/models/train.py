@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ..typing import PADDING_ID
+
 
 class TrainState(NamedTuple):
     params: Any
@@ -64,6 +66,130 @@ def make_train_step(model, tx, batch_size: int,
         return TrainState(params, opt_state, state.step + 1), loss, acc
 
     return train_step
+
+
+def make_pipelined_train_step(model, tx, sampler, rows, labels,
+                              batch_size: int, dropout_seed: int = 0):
+    """Fuse "train batch k" with "sample batch k+1" into ONE XLA program.
+
+    The reference hides sampling latency behind training with up to 32
+    concurrent in-flight batches per CPU/GPU worker
+    (distributed/dist_neighbor_sampler.py:88-174, dist_options.py:21-100).
+    On TPU both stages run on the same chip, so concurrency can't come
+    from extra workers — it comes from the compiler: inside one program
+    the sampler's gather/DMA chains carry no data dependency on the train
+    step's matmuls, so XLA's scheduler interleaves HBM traffic for batch
+    ``k+1``'s sampling with MXU work for batch ``k``'s fwd/bwd instead of
+    running the two phases back-to-back (the serial two-program layout).
+
+    Args:
+      sampler: a :class:`~glt_tpu.sampler.neighbor_sampler.NeighborSampler`
+        (its pure ``_sample_impl`` is traced into the fused program).
+      rows: ``[N, d]`` device-resident feature matrix (config-1 layout:
+        products features fit HBM whole).
+      labels: ``[N]`` int device array.
+
+    Returns ``(step, sample_first)``:
+      * ``sample_first(seeds, key) -> out`` — jitted prologue for batch 0;
+      * ``step(state, out_k, seeds_k1, key_k1) -> (state, loss, acc,
+        out_k1)`` — one fused program; pass ``seeds_k1=None``'s stand-in
+        (any batch, e.g. the first) for the epilogue call and drop its
+        ``out``.
+    """
+    import numpy as np
+
+    from ..data.feature import Feature
+
+    g = sampler.graph
+    labels = jnp.asarray(labels)
+    if not isinstance(rows, Feature):
+        rows = Feature(np.asarray(rows))
+    if rows.hot_count < rows.size:
+        raise ValueError(
+            "pipelined step needs a fully device-resident Feature "
+            "(split_ratio=1.0); use the tiered pipeline for host tiers")
+    feature = rows
+
+    def gather_xy(out):
+        x = feature.gather(out.node)
+        safe = jnp.clip(out.node, 0, labels.shape[0] - 1)
+        y = jnp.where(out.node >= 0,
+                      jnp.take(labels, safe, axis=0), PADDING_ID)
+        return x, y
+
+    # Graph arrays ride as jit arguments (they may be host numpy or, on a
+    # mesh, process-spanning global arrays — neither may be closed over).
+    # The sampler's own jitted program serves as the prologue — no second
+    # compilation of the identical sampling executable.
+    def sample_first(seeds, key):
+        return sampler._sample_jit(g.indptr, g.indices, g.gather_edge_ids,
+                                   jnp.asarray(seeds, jnp.int32), key)
+
+    # out_prev's buffers are dead after the train half: donate them so the
+    # next batch's SamplerOutput reuses the allocation.
+    @partial(jax.jit, donate_argnums=(4,))
+    def _step(indptr, indices, eids, state: TrainState, out_prev,
+              seeds_next, key_next):
+        out_next = sampler._sample_impl(indptr, indices, eids, seeds_next,
+                                        key_next)
+        x, y = gather_xy(out_prev)
+        edge_index = jnp.stack([out_prev.row, out_prev.col])
+        rng = jax.random.fold_in(jax.random.PRNGKey(dropout_seed),
+                                 state.step)
+
+        def loss_fn(params):
+            logits = model.apply(params, x, edge_index,
+                                 out_prev.edge_mask, train=True,
+                                 rngs={"dropout": rng})
+            return seed_cross_entropy(logits, y, batch_size,
+                                      out_prev.node_mask)
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (TrainState(params, opt_state, state.step + 1), loss, acc,
+                out_next)
+
+    def step(state: TrainState, out_prev, seeds_next, key_next):
+        return _step(g.indptr, g.indices, g.gather_edge_ids, state,
+                     out_prev, jnp.asarray(seeds_next, jnp.int32),
+                     key_next)
+
+    return step, sample_first
+
+
+def run_pipelined_epoch(step, sample_first, seed_batches, state,
+                        base_key) -> tuple:
+    """Drive one epoch of the fused pipeline.
+
+    ``seed_batches``: iterable of ``[batch_size]`` int32 device/host seed
+    arrays.  Returns ``(state, losses, accs)`` — device scalars, unsynced,
+    one per batch (every batch is trained exactly once; the final batch's
+    train half runs in an epilogue step whose sample half re-samples batch
+    0 and is discarded).
+    """
+    import jax.numpy as jnp
+
+    losses, accs = [], []
+    out = None
+    first = None
+    for i, seeds in enumerate(seed_batches):
+        seeds = jnp.asarray(seeds)
+        k = jax.random.fold_in(base_key, i)
+        if out is None:
+            out = sample_first(seeds, k)
+            first = seeds
+            continue
+        state, loss, acc, out = step(state, out, seeds, k)
+        losses.append(loss)
+        accs.append(acc)
+    if out is not None:
+        state, loss, acc, _ = step(state, out, first,
+                                   jax.random.fold_in(base_key, 2**31 - 1))
+        losses.append(loss)
+        accs.append(acc)
+    return state, losses, accs
 
 
 def make_eval_step(model, batch_size: int) -> Callable:

@@ -106,7 +106,7 @@ class DistHeteroNeighborSampler:
     def _one_hop(self, et, arrays, frontier, fanout, key):
         indptr, indices, edge_ids = arrays
         g = self.sharded[et]
-        nbrs, eids, mask = exchange_one_hop(
+        nbrs, eids, mask, _ = exchange_one_hop(
             frontier, indptr, indices, edge_ids, g.nodes_per_shard,
             g.num_shards, fanout, key, self.axis_name)
         return NeighborOutput(nbrs=nbrs, eids=eids, mask=mask)

@@ -48,6 +48,7 @@ def make_dist_train_step(
     axis_name: str = "shard",
     frontier_cap: Optional[int] = None,
     last_hop_dedup: bool = True,
+    exchange_load_factor: Optional[float] = None,
 ):
     """Build ``step(state, seeds [S, B], key) -> (state, loss, acc)``.
 
@@ -57,6 +58,8 @@ def make_dist_train_step(
     ``last_hop_dedup=False`` selects the leaf-block final hop (see
     NeighborSampler) — loss/acc are over seed rows, which stay in the
     compact interior prefix, so the objective is unchanged.
+    ``exchange_load_factor`` bounds the sampler's all-to-all buckets (see
+    :func:`~glt_tpu.parallel.dist_sampler.dist_sample_multi_hop`).
     """
     gspec = P(axis_name)
 
@@ -69,7 +72,8 @@ def make_dist_train_step(
         out = dist_sample_multi_hop(
             indptr, indices, edge_ids, seeds, key, num_neighbors,
             g.nodes_per_shard, g.num_shards, axis_name, frontier_cap,
-            last_hop_dedup=last_hop_dedup)
+            last_hop_dedup=last_hop_dedup,
+            exchange_load_factor=exchange_load_factor)
         x = exchange_gather(out.node, rows, f.nodes_per_shard,
                             f.num_shards, axis_name)
         y = exchange_gather(out.node, labels_blk[:, None].astype(jnp.int32),
@@ -95,13 +99,22 @@ def make_dist_train_step(
         out_specs=(P(), P(), P()),
         check_vma=False)
 
+    # The sharded graph/feature/label arrays ride as jit ARGUMENTS, not
+    # closure captures: multi-host global arrays span non-addressable
+    # devices and may not be closed over.
     @jax.jit
-    def step(state: TrainState, seeds: jnp.ndarray, key: jax.Array):
-        loss, acc, grads = shard_fn(g.indptr, g.indices, g.edge_ids,
-                                    f.rows, labels, seeds, state.params, key)
+    def _step(indptr, indices, edge_ids, rows, labels_blk,
+              state: TrainState, seeds: jnp.ndarray, key: jax.Array):
+        loss, acc, grads = shard_fn(indptr, indices, edge_ids,
+                                    rows, labels_blk, seeds, state.params,
+                                    key)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss, acc
+
+    def step(state: TrainState, seeds: jnp.ndarray, key: jax.Array):
+        return _step(g.indptr, g.indices, g.edge_ids, f.rows, labels,
+                     state, seeds, key)
 
     return step
 
@@ -162,13 +175,18 @@ def make_tiered_train_step(
         out_specs=(P(), P(), P()),
         check_vma=False)
 
+    # Global arrays as jit arguments (multi-host: no closure capture).
     @jax.jit
-    def train(state: TrainState, out, staged_resp, key: jax.Array):
-        loss, acc, grads = shard_fn(f.hot, labels, out, staged_resp,
+    def _train(hot_rows, labels_blk, state: TrainState, out, staged_resp,
+               key: jax.Array):
+        loss, acc, grads = shard_fn(hot_rows, labels_blk, out, staged_resp,
                                     state.params, key)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss, acc
+
+    def train(state: TrainState, out, staged_resp, key: jax.Array):
+        return _train(f.hot, labels, state, out, staged_resp, key)
 
     return train
 
@@ -192,10 +210,18 @@ class TieredTrainPipeline:
                  cold_store: Optional[HostColdStore] = None):
         import concurrent.futures
 
+        from . import multihost
+
         self.sampler = sampler
         self.train_step = train_step
         self.f = f
-        self.cold_store = cold_store or HostColdStore(f)
+        self.mesh = mesh
+        self.axis_name = axis_name
+        # This process's contiguous shard block (all shards when
+        # single-process); the cold store serves exactly these.
+        self._local = multihost.local_shard_range(mesh, axis_name)
+        self.cold_store = cold_store or HostColdStore(
+            f, shard_ids=self._local)
         self._cold_spec = jax.sharding.NamedSharding(mesh, P(axis_name))
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="glt-cold-stage")
@@ -211,24 +237,28 @@ class TieredTrainPipeline:
         """Submit the cold staging for ``out.node``; returns a future.
 
         Route (in-jit id all_to_all) -> per-shard host gather from this
-        host's cold store -> device_put of the responder-side block.  On a
-        pod each process serves only its local shards; here one process
-        serves all of them.
+        host's cold store -> per-host feed of the responder-side block.
+        Each process serves only its local shards (all of them in the
+        single-process emulation) and feeds only its slab of the global
+        staged array — remote slabs are produced by their own hosts.
         """
+        from . import multihost
+
         cold_req = self._route(out.node)
 
         def work():
-            req = np.asarray(cold_req)    # waits on the route stage only
-            # Serve only the store's local shards (all of them in the
-            # single-process emulation; on a pod, this host's subset —
-            # remote shards' slices stay zero here and are filled by
-            # their own hosts' device_put).
+            # Fetch only this host's addressable request rows (waits on
+            # the route stage only).
+            shards = sorted(cold_req.addressable_shards,
+                            key=lambda sh: sh.index[0].start or 0)
+            req = np.concatenate([np.asarray(sh.data) for sh in shards])
             staged = np.zeros(
-                (self.f.num_shards, req.shape[1], self.cold_store.dim),
+                (len(self._local), req.shape[1], self.cold_store.dim),
                 self.cold_store.dtype)
-            for s in self.cold_store.shard_ids:
-                staged[s] = self.cold_store.serve(s, req[s])
-            return jax.device_put(staged, self._cold_spec)
+            for j, s in enumerate(self._local):
+                staged[j] = self.cold_store.serve(s, req[j])
+            return multihost.assemble_global(staged, self.mesh,
+                                             self.axis_name)
         return self._pool.submit(work)
 
     def run_epoch(self, state: TrainState, seed_batches, key: jax.Array):
@@ -236,12 +266,19 @@ class TieredTrainPipeline:
 
         Returns ``(state, losses, accs)`` (device scalars, unsynced).
         """
+        from . import multihost
+
         losses, accs = [], []
         pending = None  # (out, cold future)
         n = 0
         for i, seeds in enumerate(seed_batches):
             kb = jax.random.fold_in(key, i)
-            out = self.sampler.sample_from_nodes(jnp.asarray(seeds),
+            if not isinstance(seeds, jax.Array):
+                # Per-host feed: every process holds the full [S, B] host
+                # batch (deterministic split) and contributes its rows.
+                seeds = multihost.feed_seeds(np.asarray(seeds), self.mesh,
+                                             self.axis_name)
+            out = self.sampler.sample_from_nodes(seeds,
                                                  key=jax.random.fold_in(kb, 1))
             fut = self._stage_cold_async(out)
             if pending is not None:
@@ -362,13 +399,18 @@ def make_hetero_dist_train_step(
         out_specs=(P(), P(), P()),
         check_vma=False)
 
+    # Global arrays as jit arguments (multi-host: no closure capture).
     @jax.jit
-    def step(state: TrainState, seeds: jnp.ndarray, key: jax.Array):
-        loss, acc, grads = shard_fn(arrays, rows, labels, seeds,
-                                    state.params, key)
+    def _step(arrays_arg, rows_arg, labels_blk, state: TrainState,
+              seeds: jnp.ndarray, key: jax.Array):
+        loss, acc, grads = shard_fn(arrays_arg, rows_arg, labels_blk,
+                                    seeds, state.params, key)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss, acc
+
+    def step(state: TrainState, seeds: jnp.ndarray, key: jax.Array):
+        return _step(arrays, rows, labels, state, seeds, key)
 
     return step
 

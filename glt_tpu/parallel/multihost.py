@@ -1,0 +1,257 @@
+"""Multi-host execution: process-spanning mesh + per-host array feeding.
+
+TPU-native replacement for the reference's cross-machine plane.  There,
+every process joins a torch-RPC universe: ``init_rpc`` all-gathers
+``(role, world_size, rank)`` tuples from every process to build name
+tables (distributed/rpc.py:236-292), with rendezvous via the
+``MASTER_ADDR``/``MASTER_PORT`` env convention
+(distributed/dist_options.py:75-100), and every cross-host sample/feature
+request is an RPC.
+
+On TPU none of that machinery survives: the cross-host plane is
+``jax.distributed`` — one coordinator process, every process contributes
+its local chips to ONE global :class:`~jax.sharding.Mesh`, and the
+collectives inside the jitted programs (`dist_sampler`, `dist_feature`,
+`dist_train`) ride ICI within a host and DCN between hosts, routed by XLA
+from the same sharding annotations that drove the single-process path.
+The "name table" is the device mesh; the "partition book" stays
+arithmetic.  What this module adds is the *host-side seam*:
+
+* :func:`initialize` — rendezvous (env-var conventions kept from the
+  reference: ``MASTER_ADDR``/``MASTER_PORT``, plus ``GLT_*`` overrides);
+* :func:`global_mesh` — a mesh over every process's devices;
+* per-host **global array assembly** — each process feeds only the shard
+  blocks it owns (graph CSR blocks, feature rows, labels, seed batches)
+  via ``jax.make_array_from_process_local_data``, so no host ever
+  materialises another host's partition.
+
+Single-process meshes are the degenerate case: every helper works
+unchanged when ``jax.process_count() == 1``, so the training-step
+builders in :mod:`~glt_tpu.parallel.dist_train` need no changes at all —
+the same jitted program runs on a laptop mesh, a v5e-8, or a multi-host
+v5e-16 (4 processes x 4 chips).
+
+Emulation without a pod (the reference's single-host multi-process test
+strategy, SURVEY §4): spawn N processes with
+``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=K``
+and a localhost coordinator; collectives cross process boundaries over
+gloo.  See tests/test_multihost.py.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..data.topology import CSRTopo
+from .sharding import ShardedFeature, ShardedGraph, shard_graph_blocks
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-host runtime (idempotent).
+
+    Defaults come from the environment, keeping the reference's rendezvous
+    convention (dist_options.py:75-100): ``MASTER_ADDR``/``MASTER_PORT``
+    form the coordinator address, ``WORLD_SIZE``/``RANK`` (or the
+    explicit ``GLT_NUM_PROCESSES``/``GLT_PROCESS_ID``) give the fleet
+    shape.  On Cloud TPU pods with no env set, ``jax.distributed``
+    auto-detects all three from the TPU metadata server.
+    """
+    # NOTE: must not touch the backend (jax.devices / process_count)
+    # before jax.distributed.initialize — only the client handle check
+    # below is safe.
+    if _initialized():
+        return
+    # Ambient TPU-tunnel hooks (sitecustomize) may pin
+    # jax.config.jax_platforms at interpreter start, which outranks the
+    # JAX_PLATFORMS env var; restore the env var's intent so CPU-fleet
+    # emulation works under those hooks.
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
+    if coordinator_address is None:
+        addr = os.environ.get("GLT_COORDINATOR_ADDR")
+        if addr is None:
+            host = os.environ.get("MASTER_ADDR")
+            port = os.environ.get("MASTER_PORT")
+            addr = f"{host}:{port}" if host and port else None
+        coordinator_address = addr
+    if num_processes is None:
+        n = os.environ.get("GLT_NUM_PROCESSES",
+                           os.environ.get("WORLD_SIZE"))
+        num_processes = int(n) if n is not None else None
+    if process_id is None:
+        r = os.environ.get("GLT_PROCESS_ID", os.environ.get("RANK"))
+        process_id = int(r) if r is not None else None
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def _initialized() -> bool:
+    state = getattr(jax.distributed, "global_state", None)
+    return state is not None and state.client is not None
+
+
+def shutdown() -> None:
+    if _initialized():
+        jax.distributed.shutdown()
+
+
+def global_mesh(axis_name: str = "shard") -> Mesh:
+    """One-axis mesh over every device of every process.
+
+    ``jax.devices()`` orders devices so each process's block is
+    contiguous, so shard ``s`` of any array sharded on ``axis_name`` is
+    addressable exactly by the process owning device ``s``.
+    """
+    return Mesh(np.array(jax.devices()), (axis_name,))
+
+
+def local_shard_range(mesh: Mesh, axis_name: str = "shard") -> range:
+    """Global shard indices whose device lives in this process.
+
+    The per-host feeding helpers build host data only for this range (the
+    reference's "each machine loads its own partition",
+    dist_dataset.py:77-164).  Raises if the local block is not contiguous
+    — the contiguous-ownership invariant the arithmetic partition book
+    depends on.
+    """
+    devs = mesh.devices.reshape(-1)
+    mine = [i for i, d in enumerate(devs)
+            if d.process_index == jax.process_index()]
+    if not mine:
+        return range(0)
+    lo, hi = min(mine), max(mine) + 1
+    if mine != list(range(lo, hi)):
+        raise ValueError(
+            f"local devices are not contiguous on mesh axis {axis_name!r}: "
+            f"{mine}")
+    return range(lo, hi)
+
+
+def assemble_global(local_block: np.ndarray, mesh: Mesh,
+                    axis_name: str = "shard") -> jax.Array:
+    """Per-process ``[S_local, ...]`` block -> global ``[S, ...]`` array.
+
+    Every process calls this with its own shards' slab; the result is one
+    logical array sharded over ``axis_name`` whose device-local data never
+    crossed hosts.
+    """
+    sharding = NamedSharding(mesh, P(axis_name))
+    num_shards = mesh.devices.size
+    global_shape = (num_shards,) + tuple(local_block.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(local_block), global_shape)
+
+
+def agree_max(value: int) -> int:
+    """Max of a host-side int across processes (single-process: identity).
+
+    Used to agree on padding widths (e.g. the per-shard edge-block width)
+    when each host computed its own from local partitions only.
+    """
+    if jax.process_count() == 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+
+    all_vals = multihost_utils.process_allgather(
+        np.asarray([value], np.int64))
+    return int(np.max(all_vals))
+
+
+def agree_sum(arr: np.ndarray) -> np.ndarray:
+    """Elementwise sum of a host array across processes.
+
+    Used for global statistics assembled from per-partition data (e.g.
+    in-degree hotness when each host holds only its partitions' edges).
+    O(N * num_processes) gather — pass precomputed global stats instead
+    when N is huge.
+    """
+    arr = np.asarray(arr)
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+
+    return np.sum(multihost_utils.process_allgather(arr), axis=0)
+
+
+# -- per-host sharded construction ----------------------------------------
+
+def shard_graph_global(topo: CSRTopo, mesh: Mesh,
+                       axis_name: str = "shard") -> ShardedGraph:
+    """Full-topology convenience: every host holds ``topo`` but builds and
+    feeds only its own shards' CSR blocks.
+
+    For hosts that hold only their partitions' edges, build local blocks
+    with :func:`~glt_tpu.parallel.sharding.shard_graph_blocks` +
+    :func:`agree_max` and assemble with :func:`assemble_global` (that is
+    what :meth:`DistDataset.load <glt_tpu.distributed.dist_dataset.
+    DistDataset.load>` does when given a mesh).
+    """
+    num_shards = mesh.devices.size
+    rng = local_shard_range(mesh, axis_name)
+    ip, ix, ei, c = shard_graph_blocks(topo, num_shards, shard_range=rng)
+    return ShardedGraph(
+        indptr=assemble_global(ip, mesh, axis_name),
+        indices=assemble_global(ix, mesh, axis_name),
+        edge_ids=assemble_global(ei, mesh, axis_name),
+        nodes_per_shard=c, num_nodes=topo.num_nodes, num_shards=num_shards)
+
+
+def shard_feature_global(feature: np.ndarray, mesh: Mesh,
+                         axis_name: str = "shard",
+                         dtype=None) -> ShardedFeature:
+    """``[N, d]`` rows (or this host's slice of them) -> per-host-fed
+    :class:`ShardedFeature`.
+
+    ``feature`` may be the full matrix (every host slices its own rows) —
+    hosts holding only their partitions' rows should pass those through
+    :func:`assemble_global` directly.
+    """
+    feature = np.asarray(feature)
+    n, d = feature.shape
+    num_shards = mesh.devices.size
+    c = -(-n // num_shards)
+    rng = local_shard_range(mesh, axis_name)
+    rows = np.zeros((len(rng), c, d), feature.dtype if dtype is None
+                    else np.dtype(dtype))
+    for j, s in enumerate(rng):
+        lo, hi = min(s * c, n), min((s + 1) * c, n)
+        rows[j, : hi - lo] = feature[lo:hi]
+    return ShardedFeature(rows=assemble_global(rows, mesh, axis_name),
+                          nodes_per_shard=c, num_shards=num_shards)
+
+
+def labels_global(labels: np.ndarray, mesh: Mesh, nodes_per_shard: int,
+                  axis_name: str = "shard", fill: int = -1) -> jax.Array:
+    """Global ``[N]`` labels -> ``[S, c]`` sharded block, fed per host."""
+    labels = np.asarray(labels)
+    n = labels.shape[0]
+    num_shards = mesh.devices.size
+    c = nodes_per_shard
+    rng = local_shard_range(mesh, axis_name)
+    blk = np.full((len(rng), c), fill, labels.dtype)
+    for j, s in enumerate(rng):
+        lo, hi = min(s * c, n), min((s + 1) * c, n)
+        blk[j, : hi - lo] = labels[lo:hi]
+    return assemble_global(blk, mesh, axis_name)
+
+
+def feed_seeds(seeds: np.ndarray, mesh: Mesh,
+               axis_name: str = "shard") -> jax.Array:
+    """``[S, B]`` per-shard seed batch -> global array, fed per host.
+
+    Every host may hold the full ``[S, B]`` matrix (the deterministic
+    epoch split of :meth:`DistDataset.split_seeds` is reproducible from a
+    shared seed) — each feeds only its own rows.
+    """
+    seeds = np.asarray(seeds)
+    rng = local_shard_range(mesh, axis_name)
+    return assemble_global(seeds[rng.start: rng.stop], mesh, axis_name)

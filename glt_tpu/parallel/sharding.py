@@ -50,6 +50,61 @@ class ShardedFeature(NamedTuple):
     num_shards: int
 
 
+def shard_bounds(topo: CSRTopo, num_shards: int):
+    """Per-shard node/edge ranges of the contiguous split.
+
+    Returns ``(c, bounds, max_e)``: nodes per shard, a list of
+    ``(lo, hi, e0, e1)`` per shard, and the max per-shard edge count (the
+    rectangular padding width).  Cheap — touches only ``indptr``.
+    """
+    n = topo.num_nodes
+    c = -(-n // num_shards)  # ceil
+    indptr = topo.indptr
+    max_e = 0
+    bounds = []
+    for s in range(num_shards):
+        lo, hi = min(s * c, n), min((s + 1) * c, n)
+        e0, e1 = int(indptr[lo]), int(indptr[hi])
+        bounds.append((lo, hi, e0, e1))
+        max_e = max(max_e, e1 - e0)
+    return c, bounds, max_e
+
+
+def shard_graph_blocks(topo: CSRTopo, num_shards: int,
+                       shard_range: Optional[range] = None,
+                       pad_edges: Optional[int] = None):
+    """Host-side numpy CSR blocks for ``shard_range`` (default: all).
+
+    Returns ``(ip, ix, ei, c)`` with leading axis ``len(shard_range)``.
+    ``pad_edges`` overrides the edge padding width (multi-host callers pass
+    the globally-agreed max so every process's blocks stack congruently).
+    """
+    n = topo.num_nodes
+    c, bounds, max_e = shard_bounds(topo, num_shards)
+    if pad_edges is not None:
+        if pad_edges < max_e:
+            raise ValueError(f"pad_edges {pad_edges} < local max {max_e}")
+        max_e = pad_edges
+    if shard_range is None:
+        shard_range = range(num_shards)
+    indptr = topo.indptr.astype(np.int64)
+    indices = topo.indices.astype(np.int32)
+    edge_ids = topo.edge_ids.astype(np.int32)
+
+    k = len(shard_range)
+    ip = np.zeros((k, c + 1), np.int32)
+    ix = np.full((k, max_e), -1, np.int32)
+    ei = np.full((k, max_e), -1, np.int32)
+    for j, s in enumerate(shard_range):
+        lo, hi, e0, e1 = bounds[s]
+        local = (indptr[lo: hi + 1] - indptr[lo]).astype(np.int32)
+        ip[j, : hi - lo + 1] = local
+        ip[j, hi - lo + 1:] = local[-1] if local.size else 0
+        ix[j, : e1 - e0] = indices[e0:e1]
+        ei[j, : e1 - e0] = edge_ids[e0:e1]
+    return ip, ix, ei, c
+
+
 def shard_graph(topo: CSRTopo, num_shards: int) -> ShardedGraph:
     """Split a CSR topology into contiguous per-shard blocks (host-side).
 
@@ -58,33 +113,11 @@ def shard_graph(topo: CSRTopo, num_shards: int) -> ShardedGraph:
     edge count so the result stacks into rectangular arrays that
     ``jax.device_put`` can shard along axis 0.
     """
-    n = topo.num_nodes
-    c = -(-n // num_shards)  # ceil
-    indptr = topo.indptr.astype(np.int64)
-    indices = topo.indices.astype(np.int32)
-    edge_ids = topo.edge_ids.astype(np.int32)
-
-    max_e = 0
-    bounds = []
-    for s in range(num_shards):
-        lo, hi = min(s * c, n), min((s + 1) * c, n)
-        e0, e1 = int(indptr[lo]), int(indptr[hi])
-        bounds.append((lo, hi, e0, e1))
-        max_e = max(max_e, e1 - e0)
-
-    ip = np.zeros((num_shards, c + 1), np.int32)
-    ix = np.full((num_shards, max_e), -1, np.int32)
-    ei = np.full((num_shards, max_e), -1, np.int32)
-    for s, (lo, hi, e0, e1) in enumerate(bounds):
-        local = (indptr[lo: hi + 1] - indptr[lo]).astype(np.int32)
-        ip[s, : hi - lo + 1] = local
-        ip[s, hi - lo + 1:] = local[-1] if local.size else 0
-        ix[s, : e1 - e0] = indices[e0:e1]
-        ei[s, : e1 - e0] = edge_ids[e0:e1]
+    ip, ix, ei, c = shard_graph_blocks(topo, num_shards)
     return ShardedGraph(
         indptr=jnp.asarray(ip), indices=jnp.asarray(ix),
-        edge_ids=jnp.asarray(ei), nodes_per_shard=c, num_nodes=n,
-        num_shards=num_shards)
+        edge_ids=jnp.asarray(ei), nodes_per_shard=c,
+        num_nodes=topo.num_nodes, num_shards=num_shards)
 
 
 def shard_feature(feature: np.ndarray, num_shards: int,

@@ -1,0 +1,165 @@
+"""Multi-host emulation worker: one process of an N-process CPU fleet.
+
+Launched as a plain subprocess (NOT under pytest/conftest) by
+tests/test_multihost.py and __graft_entry__.dryrun_multichip:
+
+    python tests/_multihost_worker.py <proc_id> <nproc> <port> <ndev> <steps>
+
+Each process owns ``ndev`` virtual CPU devices; together they form one
+``nproc * ndev``-device global mesh (the reference's multi-process
+single-host test topology, test/python/dist_test_utils.py, rebuilt on
+jax.distributed + gloo).  Prints one JSON line with the per-step losses —
+the parent asserts they match the single-process run bit-for-bit
+modulo collective reduction order.
+
+The fixture (ring graph, id-determined features/labels) is importable
+without jax side effects; workers and the in-process reference run the
+exact same steps via :func:`run_steps`.
+"""
+import json
+import os
+import sys
+
+
+def build_fixture(n_total_devices: int):
+    """Deterministic ring graph; features/labels are functions of node id."""
+    import numpy as np
+
+    n, dim, classes = 16 * n_total_devices, 8, 4
+    src = np.repeat(np.arange(n), 2)
+    dst = np.concatenate([[(i + 1) % n, (i + 3) % n] for i in range(n)])
+    feat = np.eye(dim, dtype=np.float32)[np.arange(n) % dim]
+    labels = (np.arange(n) % classes).astype(np.int32)
+    seeds = np.stack([np.arange(s * 16, s * 16 + 4)
+                      for s in range(n_total_devices)]).astype(np.int32)
+    return np.stack([src, dst]), n, feat, labels, classes, seeds
+
+
+def run_steps(mesh, num_steps: int):
+    """Run ``num_steps`` fused dist-train steps on ``mesh``; return losses.
+
+    Uses the per-host feeding path (multihost helpers) regardless of
+    process count — single-process is the degenerate case, which is
+    exactly what makes the two runs comparable.
+    """
+    import jax
+    import numpy as np
+    import optax
+
+    from glt_tpu.data.topology import CSRTopo
+    from glt_tpu.models import GraphSAGE
+    from glt_tpu.parallel import multihost
+    from glt_tpu.parallel.dist_train import (
+        init_dist_state,
+        make_dist_train_step,
+    )
+
+    n_dev = mesh.devices.size
+    edge_index, n, feat, labels, classes, seeds = build_fixture(n_dev)
+    topo = CSRTopo(edge_index, num_nodes=n)
+
+    g = multihost.shard_graph_global(topo, mesh)
+    f = multihost.shard_feature_global(feat, mesh)
+    lab = multihost.labels_global(labels, mesh, g.nodes_per_shard)
+
+    model = GraphSAGE(hidden_features=16, out_features=classes,
+                      num_layers=2, dropout_rate=0.0)
+    tx = optax.adam(1e-3)
+    batch_size, fanouts = 4, [2, 2]
+    state = init_dist_state(model, tx, g, f, jax.random.PRNGKey(0),
+                            fanouts, batch_size)
+    step = make_dist_train_step(model, tx, g, f, lab, mesh, fanouts,
+                                batch_size)
+
+    losses = []
+    for i in range(num_steps):
+        sd = multihost.feed_seeds(seeds, mesh)
+        state, loss, acc = step(state, sd, jax.random.PRNGKey(i + 1))
+        # Replicated outputs are addressable on every process.
+        losses.append(float(np.asarray(jax.device_get(loss))))
+    return losses
+
+
+def make_partition_dir(part_dir: str, n_total_devices: int) -> None:
+    """Partition the fixture graph (graph + features) into ``part_dir``."""
+    from glt_tpu.partition import RandomPartitioner
+
+    edge_index, n, feat, labels, classes, seeds = build_fixture(
+        n_total_devices)
+    RandomPartitioner(part_dir, n_total_devices, n, edge_index,
+                      node_feat=feat, chunk_size=4, seed=7).partition()
+
+
+def run_dataset_steps(mesh, num_steps: int, part_dir: str):
+    """Per-host ``DistDataset.load(mesh=...)`` -> tiered pipeline steps.
+
+    Exercises the multi-host seams the plain train path does not: local-
+    partition-only loading, tiered hot/cold features fed per host, and the
+    threaded cold-staging pipeline over a process-spanning mesh.
+    """
+    import jax
+    import numpy as np
+    import optax
+
+    from glt_tpu.distributed.dist_dataset import DistDataset
+    from glt_tpu.models import GraphSAGE
+    from glt_tpu.parallel import (
+        DistNeighborSampler,
+        TieredTrainPipeline,
+    )
+    from glt_tpu.parallel.dist_train import (
+        init_dist_state,
+        make_tiered_train_step,
+    )
+
+    n_dev = mesh.devices.size
+    edge_index, n, feat, labels, classes, seeds = build_fixture(n_dev)
+    ds = DistDataset.load(part_dir, hot_ratio=0.5, labels=labels, mesh=mesh)
+
+    model = GraphSAGE(hidden_features=16, out_features=classes,
+                      num_layers=2, dropout_rate=0.0)
+    tx = optax.adam(1e-3)
+    batch_size, fanouts = 4, [2, 2]
+    state = init_dist_state(model, tx, ds.graph, ds.feature,
+                            jax.random.PRNGKey(0), fanouts, batch_size)
+    sampler = DistNeighborSampler(ds.graph, mesh, num_neighbors=fanouts,
+                                  batch_size=batch_size, seed=0)
+    train = make_tiered_train_step(model, tx, ds.graph, ds.feature,
+                                   ds.labels, mesh, batch_size)
+    pipe = TieredTrainPipeline(sampler, train, ds.feature, mesh)
+    batches = ds.split_seeds(np.arange(n), batch_size, shuffle=True, seed=3)
+    state, losses, _ = pipe.run_epoch(state, list(batches[:num_steps]),
+                                      jax.random.PRNGKey(9))
+    return [float(np.asarray(jax.device_get(l))) for l in losses]
+
+
+def main():
+    proc_id, nproc, port, ndev, steps = (int(x) for x in sys.argv[1:6])
+    mode = sys.argv[6] if len(sys.argv) > 6 else "train"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={ndev}")
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from glt_tpu.parallel import multihost
+
+    multihost.initialize(coordinator_address=f"localhost:{port}",
+                         num_processes=nproc, process_id=proc_id)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.devices()) == nproc * ndev
+
+    mesh = multihost.global_mesh()
+    if mode.startswith("dataset:"):
+        losses = run_dataset_steps(mesh, steps, mode.split(":", 1)[1])
+    else:
+        losses = run_steps(mesh, steps)
+    print(json.dumps({"proc": proc_id, "losses": losses}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,173 @@
+"""Multi-host (multi-process) execution: the process-spanning mesh.
+
+The reference emulates multi-node as multi-process on one host
+(test/python/dist_test_utils.py; SURVEY §4) — the same strategy here:
+2 real OS processes x 4 virtual CPU devices each form one 8-device global
+mesh over jax.distributed + gloo, running the SAME fused train step the
+single-process tests run.  The acceptance bar (VERDICT r3 next-round #1):
+the multi-process run's losses match the single-process 8-device run.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from _multihost_worker import build_fixture, run_steps
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_multihost_worker.py")
+STEPS = 3
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_fleet(nproc: int, ndev: int, steps: int = STEPS,
+                 mode: str = "train"):
+    """Run the worker fleet; returns per-process loss lists."""
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(i), str(nproc), str(port), str(ndev),
+         str(steps), mode],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        cwd=REPO) for i in range(nproc)]
+    results = []
+    try:
+        for p in procs:
+            results.append(p.communicate(timeout=600))
+    finally:
+        for q in procs:           # reap siblings on any failure/timeout
+            if q.poll() is None:
+                q.kill()
+    outs = []
+    for i, (p, (out, err)) in enumerate(zip(procs, results)):
+        assert p.returncode == 0, f"worker {i} failed:\n{err[-4000:]}"
+        line = [ln for ln in out.splitlines() if ln.startswith("{")][-1]
+        outs.append(json.loads(line))
+    return [o["losses"] for o in sorted(outs, key=lambda o: o["proc"])]
+
+
+@pytest.fixture(scope="module")
+def single_process_losses():
+    """Reference run: same fixture + steps on the in-process 8-CPU mesh."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shard",))
+    return run_steps(mesh, STEPS)
+
+
+def test_two_process_fleet_matches_single_process(single_process_losses):
+    per_proc = _spawn_fleet(nproc=2, ndev=4)
+    # Every process observes the same replicated loss...
+    assert per_proc[0] == pytest.approx(per_proc[1], rel=0, abs=0)
+    # ...and it matches the single-process 8-device run (same program,
+    # same RNG; tolerance covers gloo-vs-XLA reduction order).
+    assert per_proc[0] == pytest.approx(single_process_losses, rel=1e-5)
+    # Training is actually making progress, not constant.
+    assert per_proc[0][-1] < per_proc[0][0]
+
+
+def test_two_process_dataset_load_matches_single_process(tmp_path):
+    """Per-host DistDataset.load(mesh=...) + tiered pipeline: 2-process
+    fleet and single-process run load the same partitions and train to
+    the same losses."""
+    from jax.sharding import Mesh
+
+    from _multihost_worker import make_partition_dir, run_dataset_steps
+
+    part_dir = str(tmp_path / "parts")
+    make_partition_dir(part_dir, 8)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shard",))
+    ref = run_dataset_steps(mesh, 2, part_dir)
+
+    per_proc = _spawn_fleet(nproc=2, ndev=4, steps=2,
+                            mode=f"dataset:{part_dir}")
+    assert per_proc[0] == pytest.approx(per_proc[1], rel=0, abs=0)
+    assert per_proc[0] == pytest.approx(ref, rel=1e-5)
+
+
+def test_load_with_mesh_matches_plain_load(tmp_path):
+    """Single-process sanity: load(mesh=...) assembles the same arrays as
+    the all-partitions load()."""
+    from jax.sharding import Mesh
+
+    from _multihost_worker import build_fixture, make_partition_dir
+    from glt_tpu.distributed.dist_dataset import DistDataset
+
+    part_dir = str(tmp_path / "parts")
+    make_partition_dir(part_dir, 8)
+    edge_index, n, feat, labels, classes, seeds = build_fixture(8)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shard",))
+    d1 = DistDataset.load(part_dir, hot_ratio=0.5, labels=labels)
+    d2 = DistDataset.load(part_dir, hot_ratio=0.5, labels=labels, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(d1.graph.indptr),
+                                  np.asarray(d2.graph.indptr))
+    np.testing.assert_array_equal(np.asarray(d1.graph.indices),
+                                  np.asarray(d2.graph.indices))
+    np.testing.assert_array_equal(np.asarray(d1.graph.edge_ids),
+                                  np.asarray(d2.graph.edge_ids))
+    np.testing.assert_array_equal(np.asarray(d1.feature.hot),
+                                  np.asarray(d2.feature.hot))
+    np.testing.assert_array_equal(d1.feature.cold, d2.feature.cold)
+    np.testing.assert_array_equal(np.asarray(d1.labels),
+                                  np.asarray(d2.labels))
+    np.testing.assert_array_equal(d1.relabel.old2new, d2.relabel.old2new)
+
+
+def test_local_shard_range_single_process():
+    from jax.sharding import Mesh
+
+    from glt_tpu.parallel import multihost
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shard",))
+    r = multihost.local_shard_range(mesh)
+    assert (r.start, r.stop) == (0, 8)
+
+
+def test_assemble_and_feed_single_process():
+    from jax.sharding import Mesh
+
+    from glt_tpu.parallel import multihost
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shard",))
+    blk = np.arange(8 * 3, dtype=np.int32).reshape(8, 3)
+    arr = multihost.assemble_global(blk, mesh)
+    np.testing.assert_array_equal(np.asarray(arr), blk)
+    seeds = np.arange(8 * 4, dtype=np.int32).reshape(8, 4)
+    np.testing.assert_array_equal(
+        np.asarray(multihost.feed_seeds(seeds, mesh)), seeds)
+    assert multihost.agree_max(7) == 7
+
+
+def test_shard_graph_global_matches_shard_graph():
+    from jax.sharding import Mesh
+
+    from glt_tpu.data.topology import CSRTopo
+    from glt_tpu.parallel import multihost
+    from glt_tpu.parallel.sharding import shard_graph
+
+    edge_index, n, *_ = build_fixture(8)
+    topo = CSRTopo(edge_index, num_nodes=n)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shard",))
+    g1 = multihost.shard_graph_global(topo, mesh)
+    g2 = shard_graph(topo, 8)
+    np.testing.assert_array_equal(np.asarray(g1.indptr),
+                                  np.asarray(g2.indptr))
+    np.testing.assert_array_equal(np.asarray(g1.indices),
+                                  np.asarray(g2.indices))
+    np.testing.assert_array_equal(np.asarray(g1.edge_ids),
+                                  np.asarray(g2.edge_ids))
+    assert g1.nodes_per_shard == g2.nodes_per_shard
