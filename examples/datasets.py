@@ -151,9 +151,56 @@ def _synthetic_citation_hetero(node_counts, relations, scale, seed,
     return ds, np.arange(n[label_type]), classes
 
 
+def igbh_from_disk(name: str = "igbh-tiny", graph_mode: str = "HOST"):
+    """Load a converted IGB-heterogeneous dataset (scripts/convert_ogb.py
+    ``igbh`` subcommand): per-type ``<type>__feat.npy`` /
+    ``paper__labels.npy`` and per-relation
+    ``<src>__<rel>__<dst>__edges.npy``.  Reverse edge types (``rev_<rel>``)
+    are added for cross-type relations, matching the synthetic builder's
+    convention.  Returns ``(ds, train_idx, classes)`` or None if absent.
+    """
+    root = os.path.join(DATA_ROOT, name)
+    if not os.path.isdir(root):
+        return None
+    ei, feats, labels = {}, {}, None
+    for f in sorted(os.listdir(root)):
+        if not f.endswith(".npy"):
+            continue
+        stem = f[:-4]
+        arr = np.load(os.path.join(root, f), mmap_mode="r")
+        if stem.endswith("__edges"):
+            src_t, rel, dst_t = stem[: -len("__edges")].split("__")
+            edges = np.asarray(arr)
+            ei[(src_t, rel, dst_t)] = edges
+            if src_t != dst_t:
+                ei[(dst_t, f"rev_{rel}", src_t)] = edges[::-1]
+        elif stem.endswith("__feat"):
+            feats[stem[: -len("__feat")]] = np.asarray(arr, np.float32)
+        elif stem == "paper__labels":
+            labels = np.asarray(arr)
+    if labels is None or not ei:
+        return None
+    train_path = os.path.join(root, "train_idx.npy")
+    train_idx = (np.asarray(np.load(train_path)) if os.path.exists(train_path)
+                 else np.flatnonzero(labels >= 0))
+    classes = int(labels.max()) + 1
+    n = {t: f.shape[0] for t, f in feats.items()}
+    ds = (Dataset()
+          .init_graph(ei, graph_mode=graph_mode, num_nodes=n)
+          .init_node_features(feats)
+          .init_node_labels({"paper": labels.astype(np.int32)}))
+    return ds, train_idx, classes
+
+
 def synthetic_igbh(scale: float = 1.0, seed: int = 0,
                    graph_mode: str = "DEVICE"):
-    """IGBH-tiny-shaped hetero graph: paper/author/institute."""
+    """IGBH-tiny-shaped hetero graph: paper/author/institute.
+
+    Loads a converted real IGBH from ``DATA_ROOT/igbh-tiny`` when present
+    (scripts/convert_ogb.py)."""
+    real = igbh_from_disk("igbh-tiny", graph_mode="HOST")
+    if real is not None:
+        return real
     return _synthetic_citation_hetero(
         {"paper": (200, 1000), "author": (150, 800), "institute": (20, 80)},
         [("paper", "cites", "paper", 4, None),

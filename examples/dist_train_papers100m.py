@@ -63,6 +63,10 @@ def main():
                     help="fraction of each shard's rows resident in HBM")
     ap.add_argument("--part-dir", default=None,
                     help="reuse an existing partition dir")
+    ap.add_argument("--data-root", default=None,
+                    help="dir holding a converted ogbn-papers100M "
+                         "(scripts/convert_ogb.py ogbn); overrides "
+                         "GLT_DATA_ROOT; falls back to synthetic")
     args = ap.parse_args()
 
     multihost_mode = int(os.environ.get("GLT_NUM_PROCESSES", "1")) > 1
@@ -91,22 +95,46 @@ def main():
     from glt_tpu.sampler import NeighborSampler
     from glt_tpu.sampler.base import NodeSamplerInput
 
-    n = max(args.devices * args.batch_size, int(111_059_956 * args.scale))
-    rng = np.random.default_rng(0)
+    # Real converted ogbn-papers100M (scripts/convert_ogb.py) when on
+    # disk; synthetic power-law graph otherwise.
+    import examples.datasets as exds
 
-    # Power-law-ish citation graph: preferential attachment by squared rank.
-    deg_rank = rng.permutation(n)
-    popularity = 1.0 / (1.0 + deg_rank.astype(np.float64)) ** 0.8
-    popularity /= popularity.sum()
-    avg_deg = 15
-    src = rng.integers(0, n, n * avg_deg)
-    dst = rng.choice(n, n * avg_deg, p=popularity)
-    edge_index = np.stack([src, dst]).astype(np.int64)
-    labels = (deg_rank % args.classes).astype(np.int32)
-    feat = rng.normal(0, 1, (n, args.dim)).astype(np.float32)
-    feat[:, 0] = labels  # learnable signal
-    train_idx = rng.choice(n, max(n // 10, args.devices * args.batch_size),
-                           replace=False)
+    if args.data_root:
+        exds.DATA_ROOT = args.data_root
+    real_root = os.path.join(exds.DATA_ROOT, "ogbn-papers100M")
+    if os.path.isdir(real_root):
+        load = lambda f: np.load(os.path.join(real_root, f + ".npy"),
+                                 mmap_mode="r")
+        indptr = np.asarray(load("indptr"))
+        indices = np.asarray(load("indices"))
+        from glt_tpu.utils.topo import csr_to_coo
+
+        edge_index = np.stack(csr_to_coo(indptr, indices)).astype(np.int64)
+        feat = np.asarray(load("feat"), np.float32)
+        labels = np.asarray(load("labels"), np.int32)
+        train_idx = np.asarray(load("train_idx"))
+        n = indptr.shape[0] - 1
+        args.classes = int(labels.max()) + 1
+        print(f"real papers100M: {n} nodes, {edge_index.shape[1]} edges")
+    else:
+        n = max(args.devices * args.batch_size,
+                int(111_059_956 * args.scale))
+        rng = np.random.default_rng(0)
+
+        # Power-law-ish citation graph: preferential attachment by rank.
+        deg_rank = rng.permutation(n)
+        popularity = 1.0 / (1.0 + deg_rank.astype(np.float64)) ** 0.8
+        popularity /= popularity.sum()
+        avg_deg = 15
+        src = rng.integers(0, n, n * avg_deg)
+        dst = rng.choice(n, n * avg_deg, p=popularity)
+        edge_index = np.stack([src, dst]).astype(np.int64)
+        labels = (deg_rank % args.classes).astype(np.int32)
+        feat = rng.normal(0, 1, (n, args.dim)).astype(np.float32)
+        feat[:, 0] = labels  # learnable signal
+        train_idx = rng.choice(n,
+                               max(n // 10, args.devices * args.batch_size),
+                               replace=False)
 
     is_main = (not multihost_mode) or jax.process_index() == 0
     part_dir = args.part_dir or os.path.join(

@@ -109,7 +109,15 @@ def main():
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--distributed", type=int, default=0, metavar="N",
                     help="train on an N-device mesh (0 = single device)")
+    ap.add_argument("--data-root", default=None,
+                    help="dir holding a converted IGBH "
+                         "(scripts/convert_ogb.py igbh); overrides "
+                         "GLT_DATA_ROOT")
     args = ap.parse_args()
+    if args.data_root:
+        import examples.datasets as _exds
+
+        _exds.DATA_ROOT = args.data_root
 
     if args.distributed:
         return run_distributed(args)

@@ -52,7 +52,15 @@ def main():
     # --no-pipelined runs the two-program loader path.
     ap.add_argument("--pipelined", action=argparse.BooleanOptionalAction,
                     default=True)
+    ap.add_argument("--data-root", default=None,
+                    help="dir holding converted real datasets "
+                         "(scripts/convert_ogb.py); overrides "
+                         "GLT_DATA_ROOT")
     args = ap.parse_args()
+    if args.data_root:
+        import examples.datasets as _exds
+
+        _exds.DATA_ROOT = args.data_root
 
     ds, train_idx = synthetic_products(scale=args.scale)
     model = GraphSAGE(hidden_features=args.hidden, out_features=47,

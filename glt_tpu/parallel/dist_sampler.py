@@ -100,6 +100,98 @@ def _bucket_by_owner(ids: jnp.ndarray, owner: jnp.ndarray, num_shards: int,
                     valid=valid & slot_valid, dropped=dropped)
 
 
+def _bucket_payload(routing: _Routing, payload: jnp.ndarray,
+                    num_shards: int, cap: int) -> jnp.ndarray:
+    """Scatter a payload array into the same bucket slots as its ids."""
+    buckets = jnp.full((num_shards * cap + 1,), PADDING_ID, jnp.int32)
+    slot = jnp.where(routing.valid, routing.slot, num_shards * cap)
+    return buckets.at[slot].set(payload)[:-1]
+
+
+def build_sorted_edge_view(indptr: jnp.ndarray, indices: jnp.ndarray):
+    """Per-shard (row, dst) pairs lex-sorted for binary search; call inside
+    ``shard_map`` (or on a single shard's block).
+
+    The distributed analog of the column-sorted auxiliary view the Graph
+    class keeps for `edge_in_csr` (random_negative_sampler.cu:37-54) —
+    here the whole local edge block is sorted by (local row, global dst)
+    so membership is one lexicographic ``lower_bound``.  Two int32 keys
+    instead of one packed int64 key: x64 stays off.
+    """
+    max_e = indices.shape[0]
+    c = indptr.shape[0] - 1
+    pos = jnp.arange(max_e, dtype=jnp.int32)
+    row = jnp.searchsorted(indptr.astype(jnp.int32), pos,
+                           side="right").astype(jnp.int32) - 1
+    n_edges = indptr[c].astype(jnp.int32)
+    valid = pos < n_edges
+    big = jnp.int32(2**31 - 1)
+    row = jnp.where(valid, row, big)
+    dst = jnp.where(valid, indices, big)
+    order = jnp.lexsort((dst, row))
+    return row[order], dst[order]
+
+
+def _pair_exists(rows_s: jnp.ndarray, dsts_s: jnp.ndarray,
+                 r: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
+    """Branchless lexicographic lower_bound over the sorted edge view."""
+    e = rows_s.shape[0]
+    last = e - 1
+    lo = jnp.zeros_like(r)
+    hi = jnp.full_like(r, e)
+    for _ in range(32):
+        cond = lo < hi
+        mid = lo + (hi - lo) // 2
+        mc = jnp.clip(mid, 0, last)
+        mr, md = rows_s[mc], dsts_s[mc]
+        less = (mr < r) | ((mr == r) & (md < d))
+        lo = jnp.where(cond & less, mid + 1, lo)
+        hi = jnp.where(cond & ~less, mid, hi)
+    lc = jnp.clip(lo, 0, last)
+    return (lo < e) & (rows_s[lc] == r) & (dsts_s[lc] == d)
+
+
+def dist_edge_exists(
+    rows_s: jnp.ndarray,
+    dsts_s: jnp.ndarray,
+    src: jnp.ndarray,
+    dst: jnp.ndarray,
+    nodes_per_shard: int,
+    num_shards: int,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Global membership test for (src, dst) pairs; call inside shard_map.
+
+    Routes each candidate pair to the shard owning ``src`` (one id
+    all-to-all + one payload all-to-all), runs the local sorted-view
+    lookup there, and routes the verdicts back — the collective rebuild
+    of the reference's strict negative check, which it *skips* in
+    distributed mode (dist_neighbor_sampler.py:327-453 uses non-strict
+    draws).  Returns ``[B]`` bool (False for padding slots).
+    """
+    b = src.shape[0]
+    my_rank = lax.axis_index(axis_name)
+    owner = jnp.where(src >= 0, src // nodes_per_shard, -1)
+    routing = _bucket_by_owner(src, owner, num_shards, cap=b)
+    dst_buckets = _bucket_payload(routing, dst, num_shards, b)
+
+    req_s = lax.all_to_all(routing.buckets.reshape(num_shards, b),
+                           axis_name, 0, 0, tiled=False).reshape(-1)
+    req_d = lax.all_to_all(dst_buckets.reshape(num_shards, b),
+                           axis_name, 0, 0, tiled=False).reshape(-1)
+
+    local = req_s - my_rank * nodes_per_shard
+    ok = (req_s >= 0) & (local >= 0) & (local < nodes_per_shard)
+    exists = _pair_exists(rows_s, dsts_s,
+                          jnp.where(ok, local, 0).astype(jnp.int32),
+                          jnp.where(ok, req_d, 0).astype(jnp.int32))
+    exists = (exists & ok).astype(jnp.int32)
+
+    resp = lax.all_to_all(exists.reshape(num_shards, b), axis_name, 0, 0,
+                          tiled=False).reshape(-1)
+    return jnp.where(routing.valid, resp[routing.slot] > 0, False)
+
+
 def exchange_one_hop(
     seeds: jnp.ndarray,
     indptr: jnp.ndarray,
@@ -595,16 +687,35 @@ class DistNeighborSampler:
                          * g.nodes_per_shard, 0, g.nodes_per_shard)
         return jnp.asarray(counts, jnp.int32)
 
+    def _sorted_edge_view(self):
+        """Per-shard lex-sorted (row, dst) view for strict negative
+        checks; built once, cached (device arrays, sharded)."""
+        if getattr(self, "_sorted_view", None) is None:
+            gspec = P(self.axis_name)
+            fn = jax.jit(jax.shard_map(
+                lambda ip, ix: tuple(
+                    a[None] for a in build_sorted_edge_view(ip[0], ix[0])),
+                mesh=self.mesh, in_specs=(gspec, gspec),
+                out_specs=(gspec, gspec), check_vma=False))
+            self._sorted_view = fn(self.g.indptr, self.g.indices)
+        return self._sorted_view
+
     def sample_from_edges(self, src: jnp.ndarray, dst: jnp.ndarray,
                           neg_sampling: Optional[NegativeSampling] = None,
-                          key: Optional[jax.Array] = None) -> SamplerOutput:
-        """Distributed seed-edge sampling with non-strict negatives.
+                          key: Optional[jax.Array] = None,
+                          strict: bool = False,
+                          trials: int = 4) -> SamplerOutput:
+        """Distributed seed-edge sampling; negatives non-strict by default.
 
         ``src`` / ``dst``: ``[S, B]`` global endpoint ids per shard (-1
-        padded).  Negatives are uniform over valid node ids — the
-        reference's distributed engine is likewise non-strict
+        padded).  The reference's distributed engine is always non-strict
         (dist_neighbor_sampler.py:327-453: "we use non-strict negative
-        sampling in distributed mode").  Returns a per-shard
+        sampling in distributed mode"); here ``strict=True`` goes beyond
+        it: candidate pairs are routed to the shard owning the source and
+        checked against its CSR block (:func:`dist_edge_exists`) over
+        ``trials`` rejection rounds, with the reference's non-strict
+        padding pass for slots that never clear
+        (random_negative_sampler.cu:153-160).  Returns a per-shard
         :class:`SamplerOutput` whose metadata carries ``edge_label_index``
         + ``edge_label`` (binary/None) or the triplet indices.
         """
@@ -613,29 +724,47 @@ class DistNeighborSampler:
         mode = None if neg_sampling is None else neg_sampling.mode
         amount = (0 if neg_sampling is None
                   else int(round(neg_sampling.amount)))
-        fn = self._get_edges_fn(mode, amount, int(src.shape[1]))
+        strict = bool(strict) and mode is not None
+        fn = self._get_edges_fn(mode, amount, int(src.shape[1]), strict,
+                                trials)
         g = self.g
+        if strict:
+            rows_s, dsts_s = self._sorted_edge_view()
+            return fn(g.indptr, g.indices, g.edge_ids, rows_s, dsts_s,
+                      src, dst, key)
         return fn(g.indptr, g.indices, g.edge_ids, src, dst, key)
 
-    def _get_edges_fn(self, mode, amount, q):
-        k = (mode, amount, q)
+    def _get_edges_fn(self, mode, amount, q, strict=False, trials=4):
+        k = (mode, amount, q, strict, trials)
         if k not in self._edges_fns:
             gspec = P(self.axis_name)
 
-            def local(indptr, indices, eids, src, dst, key):
-                out = self._edges_body(mode, amount, q, indptr[0],
-                                       indices[0], eids[0], src[0], dst[0],
-                                       key)
-                return jax.tree.map(lambda x: x[None], out)
+            if strict:
+                def local(indptr, indices, eids, rows_s, dsts_s, src, dst,
+                          key):
+                    out = self._edges_body(
+                        mode, amount, q, indptr[0], indices[0], eids[0],
+                        src[0], dst[0], key,
+                        strict_view=(rows_s[0], dsts_s[0]), trials=trials)
+                    return jax.tree.map(lambda x: x[None], out)
+
+                specs = (gspec,) * 7 + (P(),)
+            else:
+                def local(indptr, indices, eids, src, dst, key):
+                    out = self._edges_body(mode, amount, q, indptr[0],
+                                           indices[0], eids[0], src[0],
+                                           dst[0], key)
+                    return jax.tree.map(lambda x: x[None], out)
+
+                specs = (gspec,) * 5 + (P(),)
 
             self._edges_fns[k] = jax.jit(jax.shard_map(
-                local, mesh=self.mesh,
-                in_specs=(gspec, gspec, gspec, gspec, gspec, P()),
+                local, mesh=self.mesh, in_specs=specs,
                 out_specs=gspec, check_vma=False))
         return self._edges_fns[k]
 
     def _edges_body(self, mode, amount, q, indptr, indices, eids, src, dst,
-                    key):
+                    key, strict_view=None, trials=4):
         key = jax.random.fold_in(key, lax.axis_index(self.axis_name))
         kneg, ksample = jax.random.split(key)
         counts = self._valid_per_shard()
@@ -653,18 +782,53 @@ class DistNeighborSampler:
                                    dtype=jnp.int32)
             return sh * c + u % jnp.maximum(counts[sh], 1)
 
+        def strict_pairs(k, n, valid, fixed_src=None):
+            """``trials`` routed rejection rounds + non-strict padding."""
+            rows_s, dsts_s = strict_view
+            best_s = jnp.full((n,), PADDING_ID, jnp.int32)
+            best_d = jnp.full((n,), PADDING_ID, jnp.int32)
+            found = jnp.zeros((n,), bool)
+            last_s = last_d = None
+            for t in range(trials):
+                ks_, kd_ = jax.random.split(jax.random.fold_in(k, t))
+                s = (fixed_src if fixed_src is not None
+                     else uniform_ids(ks_, n))
+                d = uniform_ids(kd_, n)
+                ex = dist_edge_exists(
+                    rows_s, dsts_s, jnp.where(valid, s, PADDING_ID), d,
+                    c, s_count, self.axis_name)
+                take = valid & ~found & ~ex
+                best_s = jnp.where(take, s, best_s)
+                best_d = jnp.where(take, d, best_d)
+                found = found | take
+                last_s, last_d = s, d
+            # Padding pass: never-cleared slots keep their last draw
+            # (possibly positive) so the output is always full width.
+            pad = valid & ~found
+            best_s = jnp.where(pad, last_s, best_s)
+            best_d = jnp.where(pad, last_d, best_d)
+            return best_s, best_d
+
         if mode == "binary":
-            ks, kd = jax.random.split(kneg)
-            neg_src = uniform_ids(ks, q * amount)
-            neg_dst = uniform_ids(kd, q * amount)
             rep = jnp.repeat(src >= 0, amount)
+            if strict_view is not None:
+                neg_src, neg_dst = strict_pairs(kneg, q * amount, rep)
+            else:
+                ks, kd = jax.random.split(kneg)
+                neg_src = uniform_ids(ks, q * amount)
+                neg_dst = uniform_ids(kd, q * amount)
             neg_src = jnp.where(rep, neg_src, PADDING_ID)
             neg_dst = jnp.where(rep, neg_dst, PADDING_ID)
             seeds = jnp.concatenate([src, dst, neg_src, neg_dst])
         elif mode == "triplet":
-            neg_dst = uniform_ids(kneg, q * amount)
-            neg_dst = jnp.where(jnp.repeat(src >= 0, amount), neg_dst,
-                                PADDING_ID)
+            rep = jnp.repeat(src >= 0, amount)
+            if strict_view is not None:
+                src_rep = jnp.repeat(src, amount)
+                _, neg_dst = strict_pairs(kneg, q * amount, rep,
+                                          fixed_src=src_rep)
+            else:
+                neg_dst = uniform_ids(kneg, q * amount)
+            neg_dst = jnp.where(rep, neg_dst, PADDING_ID)
             seeds = jnp.concatenate([src, dst, neg_dst])
         else:
             seeds = jnp.concatenate([src, dst])

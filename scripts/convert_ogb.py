@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Convert standard OGB / IGB downloads to the layout the examples read.
+
+The reference's examples consume OGB datasets through the ``ogb`` package
+(`/root/reference/examples/train_sage_ogbn_products.py`) and IGBH through
+IGB's ``.npy`` dumps (`/root/reference/examples/igbh/dataset.py`).  This
+repo's examples read a flat ``.npy`` layout instead
+(examples/datasets.py):
+
+    <data-root>/<name>/{indptr,indices,feat,labels,train_idx}.npy
+
+This script produces that layout from either source, with sha256
+checksums so partial/corrupt conversions are detectable:
+
+  # ogbn-products / ogbn-arxiv / ogbn-papers100M (raw csv.gz download):
+  python scripts/convert_ogb.py ogbn --raw ~/ogb/ogbn_products/raw \
+      --split ~/ogb/ogbn_products/split/sales_ranking \
+      --out /root/data/ogbn-products --undirected
+
+  # IGB heterogeneous (IGBH) .npy dumps:
+  python scripts/convert_ogb.py igbh --raw ~/igb/tiny/processed \
+      --out /root/data/igbh-tiny --classes 19
+
+After converting, config 1 runs on the real data unmodified:
+
+    GLT_DATA_ROOT=/root/data python examples/train_sage_products.py
+
+OGB raw layout (node property prediction):
+    raw/edge.csv.gz            one "src,dst" pair per line
+    raw/num-node-list.csv.gz   single integer N
+    raw/node-feat.csv.gz       N rows of d floats
+    raw/node-label.csv.gz      N rows of 1 int
+    split/<scheme>/train.csv.gz / valid.csv.gz / test.csv.gz
+
+IGB(H) processed layout (per node type / relation):
+    <type>/node_feat.npy, paper/node_label_19.npy (or _2K),
+    <src>__<rel>__<dst>/edge_index.npy
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write(out_dir: str, arrays: dict, meta: dict) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    checks = {}
+    for name, arr in arrays.items():
+        path = os.path.join(out_dir, name + ".npy")
+        np.save(path, arr)
+        checks[name + ".npy"] = _sha256(path)
+        print(f"  wrote {name}.npy  shape={arr.shape} dtype={arr.dtype}")
+    meta = dict(meta, checksums=checks)
+    with open(os.path.join(out_dir, "META.json"), "w") as fh:
+        json.dump(meta, fh, indent=2)
+    print(f"  wrote META.json ({len(checks)} checksums)")
+
+
+def verify(out_dir: str) -> bool:
+    """Re-hash a converted dir against its recorded checksums."""
+    with open(os.path.join(out_dir, "META.json")) as fh:
+        meta = json.load(fh)
+    ok = True
+    for name, want in meta["checksums"].items():
+        got = _sha256(os.path.join(out_dir, name))
+        status = "ok" if got == want else "MISMATCH"
+        ok &= got == want
+        print(f"  {name}: {status}")
+    return ok
+
+
+def _read_csv_gz(path: str, dtype) -> np.ndarray:
+    import pandas as pd
+
+    return pd.read_csv(path, header=None).to_numpy(dtype=dtype)
+
+
+def convert_ogbn(raw: str, split: str, out: str,
+                 undirected: bool = False) -> None:
+    """OGB node-prediction raw csv.gz download -> flat npy layout."""
+    from glt_tpu.data.topology import CSRTopo
+
+    print(f"reading {raw} ...")
+    edges = _read_csv_gz(os.path.join(raw, "edge.csv.gz"), np.int64).T
+    n = int(_read_csv_gz(os.path.join(raw, "num-node-list.csv.gz"),
+                         np.int64).ravel()[0])
+    feat = _read_csv_gz(os.path.join(raw, "node-feat.csv.gz"), np.float32)
+    labels = _read_csv_gz(os.path.join(raw, "node-label.csv.gz"),
+                          np.float32).ravel()
+    # papers100M labels are float with NaN on unlabeled nodes.
+    labels = np.where(np.isnan(labels), -1, labels).astype(np.int32)
+    train_idx = _read_csv_gz(os.path.join(split, "train.csv.gz"),
+                             np.int64).ravel()
+
+    if undirected:
+        edges = np.concatenate([edges, edges[::-1]], axis=1)
+    print(f"building CSR: {n} nodes, {edges.shape[1]} edges ...")
+    topo = CSRTopo(edges, num_nodes=n)
+    _write(out, {
+        "indptr": topo.indptr.astype(np.int64),
+        "indices": topo.indices.astype(np.int32),
+        "feat": feat,
+        "labels": labels,
+        "train_idx": train_idx,
+    }, {"source": "ogbn-raw", "num_nodes": n,
+        "num_edges": int(topo.num_edges), "undirected": undirected})
+
+
+def convert_igbh(raw: str, out: str, classes: int = 19) -> None:
+    """IGB-heterogeneous processed .npy dump -> per-type/per-relation
+    layout consumed by examples.datasets.igbh_from_disk:
+
+        <out>/<type>__feat.npy, <out>/paper__labels.npy,
+        <out>/<src>__<rel>__<dst>__edges.npy, train_idx.npy
+    """
+    arrays = {}
+    node_types = []
+    for entry in sorted(os.listdir(raw)):
+        path = os.path.join(raw, entry)
+        if not os.path.isdir(path):
+            continue
+        if "__" in entry:  # relation dir
+            ei = np.load(os.path.join(path, "edge_index.npy"), mmap_mode="r")
+            arrays[f"{entry}__edges"] = np.asarray(ei).T.astype(np.int64) \
+                if ei.shape[1] == 2 else np.asarray(ei).astype(np.int64)
+        else:              # node-type dir
+            node_types.append(entry)
+            feat = np.load(os.path.join(path, "node_feat.npy"),
+                           mmap_mode="r")
+            arrays[f"{entry}__feat"] = np.asarray(feat, np.float32)
+            for lab_name in (f"node_label_{classes}.npy",
+                             "node_label_19.npy", "node_label_2K.npy"):
+                lab_path = os.path.join(path, lab_name)
+                if os.path.exists(lab_path):
+                    lab = np.asarray(
+                        np.load(lab_path, mmap_mode="r")).ravel()
+                    lab = np.where(np.isnan(lab), -1, lab).astype(np.int32)
+                    arrays[f"{entry}__labels"] = lab
+                    break
+    if "paper__labels" in arrays:
+        labeled = np.flatnonzero(arrays["paper__labels"] >= 0)
+        rng = np.random.default_rng(0)
+        arrays["train_idx"] = rng.permutation(labeled)[
+            : max(1, int(0.6 * labeled.shape[0]))]
+    _write(out, arrays, {"source": "igb-heterogeneous",
+                         "node_types": node_types, "classes": classes})
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    og = sub.add_parser("ogbn", help="OGB node-prediction raw download")
+    og.add_argument("--raw", required=True,
+                    help="the dataset's raw/ dir (edge.csv.gz etc.)")
+    og.add_argument("--split", required=True,
+                    help="the split scheme dir holding train.csv.gz")
+    og.add_argument("--out", required=True)
+    og.add_argument("--undirected", action="store_true",
+                    help="append reverse edges (ogbn-products convention)")
+
+    ig = sub.add_parser("igbh", help="IGB heterogeneous processed dump")
+    ig.add_argument("--raw", required=True,
+                    help="the size dir's processed/ (paper/, author/, ...)")
+    ig.add_argument("--out", required=True)
+    ig.add_argument("--classes", type=int, default=19)
+
+    vf = sub.add_parser("verify", help="re-hash a converted dir")
+    vf.add_argument("--out", required=True)
+
+    args = ap.parse_args()
+    if args.cmd == "ogbn":
+        convert_ogbn(args.raw, args.split, args.out, args.undirected)
+    elif args.cmd == "igbh":
+        convert_igbh(args.raw, args.out, args.classes)
+    else:
+        sys.exit(0 if verify(args.out) else 1)
+
+
+if __name__ == "__main__":
+    main()

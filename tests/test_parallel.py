@@ -247,6 +247,104 @@ class TestBoundedExchange:
         assert np.isfinite(float(loss))
 
 
+class TestStrictDistNegatives:
+    """strict=True on DistNeighborSampler.sample_from_edges (VERDICT r3
+    #7) — the reference punts to non-strict in distributed mode."""
+
+    def test_dist_edge_exists_exact(self, mesh):
+        from glt_tpu.parallel.dist_sampler import (
+            build_sorted_edge_view,
+            dist_edge_exists,
+        )
+
+        n = 64
+        sg = shard_graph(ring_topo(n), N_DEV)
+        gspec = P("shard")
+
+        def body(ip, ix, src, dst):
+            rows_s, dsts_s = build_sorted_edge_view(ip[0], ix[0])
+            return dist_edge_exists(rows_s, dsts_s, src[0], dst[0],
+                                    sg.nodes_per_shard, N_DEV, "shard")[None]
+
+        fn = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(gspec, gspec, gspec, gspec),
+            out_specs=gspec, check_vma=False))
+        # Each shard queries a mix: real edges, non-edges, remote owners,
+        # padding.
+        src = np.zeros((N_DEV, 6), np.int32)
+        dst = np.zeros((N_DEV, 6), np.int32)
+        for s in range(N_DEV):
+            base = (s * 8 + 3) % n
+            src[s] = [base, base, base, (base + 30) % n, (base + 30) % n, -1]
+            dst[s] = [(base + 1) % n, (base + 2) % n, (base + 3) % n,
+                      (base + 31) % n, (base + 35) % n, 5]
+        got = np.asarray(fn(sg.indptr, sg.indices, jnp.asarray(src),
+                            jnp.asarray(dst)))
+        want = np.zeros_like(got, dtype=bool)
+        for s in range(N_DEV):
+            for j in range(6):
+                if src[s, j] >= 0:
+                    want[s, j] = (dst[s, j] - src[s, j]) % n in (1, 2)
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("mode", ["binary", "triplet"])
+    def test_strict_negatives_absent_from_global_csr(self, mesh, mode):
+        from glt_tpu.sampler.base import NegativeSampling
+
+        n = 64
+        sg = shard_graph(ring_topo(n), N_DEV)
+        samp = DistNeighborSampler(sg, mesh, num_neighbors=[2],
+                                   batch_size=4, seed=0)
+        # Seed edges are real ring edges, spread across owners.
+        src = np.zeros((N_DEV, 4), np.int32)
+        for s in range(N_DEV):
+            src[s] = [(s * 8 + k * 7) % n for k in range(4)]
+        dst = (src + 1) % n
+        amount = 3
+        out = samp.sample_from_edges(
+            jnp.asarray(src), jnp.asarray(dst),
+            NegativeSampling(mode, amount=amount), strict=True, trials=6)
+        node = np.asarray(out.node)
+        q = 4
+        if mode == "binary":
+            eli = np.asarray(out.metadata["edge_label_index"])  # [S, 2, W]
+            for s in range(N_DEV):
+                for j in range(q, q + q * amount):   # negative slots
+                    si, di = eli[s, 0, j], eli[s, 1, j]
+                    if si < 0 or di < 0:
+                        continue
+                    gs, gd = node[s, si], node[s, di]
+                    assert (gd - gs) % n not in (1, 2), (s, gs, gd)
+        else:
+            sidx = np.asarray(out.metadata["src_index"])
+            nidx = np.asarray(out.metadata["dst_neg_index"])
+            for s in range(N_DEV):
+                for j in range(q):
+                    if sidx[s, j] < 0:
+                        continue
+                    gs = node[s, sidx[s, j]]
+                    for a in range(amount):
+                        if nidx[s, j, a] < 0:
+                            continue
+                        gd = node[s, nidx[s, j, a]]
+                        assert (gd - gs) % n not in (1, 2), (s, gs, gd)
+
+    def test_nonstrict_still_works(self, mesh):
+        from glt_tpu.sampler.base import NegativeSampling
+
+        n = 64
+        sg = shard_graph(ring_topo(n), N_DEV)
+        samp = DistNeighborSampler(sg, mesh, num_neighbors=[2],
+                                   batch_size=4, seed=0)
+        src = np.stack([np.arange(s * 8, s * 8 + 4)
+                        for s in range(N_DEV)]).astype(np.int32)
+        dst = (src + 1) % n
+        out = samp.sample_from_edges(
+            jnp.asarray(src), jnp.asarray(dst),
+            NegativeSampling("binary", amount=2), strict=False)
+        assert np.asarray(out.metadata["edge_label"]).shape[-1] == 4 + 8
+
+
 class TestDistFeature:
     def test_exchange_gather(self, mesh):
         n, d = 64, 3
