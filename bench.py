@@ -67,6 +67,12 @@ WARMUP = 3
 ITERS = 20
 
 
+def _progress(msg: str) -> None:
+    """Stage markers on stderr (stdout stays one JSON line)."""
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
 def main():
     small = os.environ.get("GLT_BENCH_SCALE") == "small"
     import contextlib
@@ -88,6 +94,7 @@ def main():
     from glt_tpu.utils import profile
     from graph_gen import build_graph, seed_batches
 
+    _progress("building graph")
     n, indptr, indices = build_graph(small)
 
     # Bypass CSRTopo's COO round-trip: install CSR arrays directly.
@@ -115,6 +122,7 @@ def main():
     # docstring — block_until_ready does not wait under the tunnel).
     acc_edges = jax.jit(lambda tot, nse: tot + nse.sum())
 
+    _progress("sampler warmup (first compile)")
     total = jnp.zeros((), jnp.int32)
     for i in range(WARMUP):
         out = sampler.sample_from_nodes(NodeSamplerInput(batches[i]))
@@ -123,6 +131,7 @@ def main():
 
     # --- pipelined (headline): enqueue everything, one fetch at the end.
     # GLT_PROFILE_DIR captures a jax profiler trace of this region.
+    _progress("pipelined sampler timing")
     prof_dir = os.environ.get("GLT_PROFILE_DIR")
     ctx = profile.trace(prof_dir) if prof_dir else contextlib.nullcontext()
     meter = profile.ThroughputMeter()
@@ -141,6 +150,7 @@ def main():
         pipelined_s = time.perf_counter() - t0
         meter.add(edges=total_edges, batches=ITERS)
 
+    _progress("serialized sampler timing")
     # --- serialized: per-batch latency (device + tunnel round-trip). ---
     t0 = time.perf_counter()
     for i in range(ITERS):
@@ -153,6 +163,7 @@ def main():
     # revisited interior nodes become fresh leaves (tree-unrolled
     # GraphSAGE semantics).  Separately reported, NOT the headline,
     # because the node-list contract differs from the reference's.
+    _progress("no-dedup leaves timing")
     s_fast = NeighborSampler(graph, FANOUT, batch_size=BATCH, seed=0,
                              with_edge=False, last_hop_dedup=False)
     total = jnp.zeros((), jnp.int32)
@@ -175,6 +186,7 @@ def main():
     # concurrency (worker_concurrency async batches,
     # dist_options.py:21-100).  Device-time parity with single-stream at
     # batch 1024; amortises host dispatch.
+    _progress("batched G8 timing")
     G = 8
     rounds = max(ITERS // G, 1)
     stacked = [jnp.stack(batches[WARMUP + r * G: WARMUP + (r + 1) * G])
@@ -207,6 +219,7 @@ def main():
     )
     from glt_tpu.loader.transform import to_batch
 
+    _progress("train-side section: building model/feature")
     hidden = 64 if small else 256
     dim, classes, fcap = (32, 47, 1024) if small else (100, 47, 8192)
     t_iters = 4 if small else 10
@@ -226,14 +239,17 @@ def main():
     state0 = TrainState(params=params, opt_state=tx.init(params),
                         step=jnp.zeros((), jnp.int32))
 
-    def gather_xy(out):
-        x = feat.gather(out.node)
-        y = jnp.where(out.node >= 0,
-                      jnp.take(labels, jnp.clip(out.node, 0, n - 1)),
-                      -1)
-        return x, y
+    # Rows/labels as explicit jit args (closure-captured GB-scale device
+    # arrays stall the remote-compile marshalling).  Reuses the library's
+    # pipelined gather so the bench measures the shipped code path
+    # (incl. the id2index indirection, if the Feature ever gains one).
+    from glt_tpu.models.train import make_gather_xy
 
-    gather_j = jax.jit(gather_xy)
+    hot = feat.hot_rows
+    _gather = jax.jit(make_gather_xy(feat.id2index))
+
+    def gather_j(out):
+        return _gather(hot, labels, out)
     tstep = make_train_step(model, tx, batch_size=BATCH)
     pstep, sample_first = make_pipelined_train_step(
         model, tx, tsampler, feat, labels, BATCH)
@@ -242,6 +258,7 @@ def main():
     def sync(x):
         return float(np.asarray(jax.device_get(x)).ravel()[0])
 
+    _progress("train-side warm compiles (sample/gather/train/pipelined)")
     # Warm compiles (sample/gather/train/pipelined).  NB: pstep DONATES
     # its out argument, so it gets its own sampled output.
     out0 = sample_first(batches[0], jax.random.fold_in(base, 999))
@@ -253,6 +270,7 @@ def main():
                             jax.random.fold_in(base, 998))
     sync(l)
 
+    _progress("train-only timing")
     # train-only: chained by the state dependency.
     st = state0
     t0 = time.perf_counter()
@@ -282,6 +300,7 @@ def main():
     sync(tot)
     sample_ms = (time.perf_counter() - t0) / t_iters * 1e3
 
+    _progress("serial step timing")
     # serial: sample -> gather -> train as separate programs per batch.
     st = state0
     t0 = time.perf_counter()
@@ -293,6 +312,7 @@ def main():
     sync(l)
     serial_ms = (time.perf_counter() - t0) / t_iters * 1e3
 
+    _progress("overlapped step timing")
     # overlapped: ONE program trains batch k while sampling batch k+1.
     st, out_k = state0, out_w
     t0 = time.perf_counter()

@@ -68,6 +68,30 @@ def make_train_step(model, tx, batch_size: int,
     return train_step
 
 
+def make_gather_xy(id2index=None):
+    """Pure ``(rows, labels, out) -> (x, y)`` batch gather.
+
+    Feature rows and labels ride as arguments (not closures) so callers
+    can jit without re-marshalling GB-scale captured arrays; ``id2index``
+    (the hotness-reorder indirection) applies to feature ROWS only —
+    labels stay indexed by global id.
+    """
+    def gather_xy(rows_arg, labels_arg, out):
+        ids = out.node
+        valid = ids >= 0
+        gid = jnp.where(valid, ids, 0)
+        ridx = (gid if id2index is None
+                else jnp.take(id2index, gid, axis=0, mode="clip"))
+        x = jnp.take(rows_arg, ridx, axis=0, mode="clip")
+        x = jnp.where(valid[:, None], x, 0)
+        y = jnp.where(valid,
+                      jnp.take(labels_arg, gid, axis=0, mode="clip"),
+                      PADDING_ID)
+        return x, y
+
+    return gather_xy
+
+
 def make_pipelined_train_step(model, tx, sampler, rows, labels,
                               batch_size: int, dropout_seed: int = 0):
     """Fuse "train batch k" with "sample batch k+1" into ONE XLA program.
@@ -109,13 +133,8 @@ def make_pipelined_train_step(model, tx, sampler, rows, labels,
             "pipelined step needs a fully device-resident Feature "
             "(split_ratio=1.0); use the tiered pipeline for host tiers")
     feature = rows
-
-    def gather_xy(out):
-        x = feature.gather(out.node)
-        safe = jnp.clip(out.node, 0, labels.shape[0] - 1)
-        y = jnp.where(out.node >= 0,
-                      jnp.take(labels, safe, axis=0), PADDING_ID)
-        return x, y
+    hot_rows = feature.hot_rows
+    gather_xy = make_gather_xy(feature.id2index)
 
     # Graph arrays ride as jit arguments (they may be host numpy or, on a
     # mesh, process-spanning global arrays — neither may be closed over).
@@ -126,13 +145,16 @@ def make_pipelined_train_step(model, tx, sampler, rows, labels,
                                    jnp.asarray(seeds, jnp.int32), key)
 
     # out_prev's buffers are dead after the train half: donate them so the
-    # next batch's SamplerOutput reuses the allocation.
-    @partial(jax.jit, donate_argnums=(4,))
-    def _step(indptr, indices, eids, state: TrainState, out_prev,
-              seeds_next, key_next):
+    # next batch's SamplerOutput reuses the allocation.  Feature rows and
+    # labels ride as jit ARGUMENTS: closure-captured device arrays of this
+    # size would be re-marshalled per compile (and may not be closed over
+    # at all on a multi-host mesh).
+    @partial(jax.jit, donate_argnums=(6,))
+    def _step(indptr, indices, eids, rows_arg, labels_arg,
+              state: TrainState, out_prev, seeds_next, key_next):
         out_next = sampler._sample_impl(indptr, indices, eids, seeds_next,
                                         key_next)
-        x, y = gather_xy(out_prev)
+        x, y = gather_xy(rows_arg, labels_arg, out_prev)
         edge_index = jnp.stack([out_prev.row, out_prev.col])
         rng = jax.random.fold_in(jax.random.PRNGKey(dropout_seed),
                                  state.step)
@@ -152,9 +174,9 @@ def make_pipelined_train_step(model, tx, sampler, rows, labels,
                 out_next)
 
     def step(state: TrainState, out_prev, seeds_next, key_next):
-        return _step(g.indptr, g.indices, g.gather_edge_ids, state,
-                     out_prev, jnp.asarray(seeds_next, jnp.int32),
-                     key_next)
+        return _step(g.indptr, g.indices, g.gather_edge_ids, hot_rows,
+                     labels, state, out_prev,
+                     jnp.asarray(seeds_next, jnp.int32), key_next)
 
     return step, sample_first
 

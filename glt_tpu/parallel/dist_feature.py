@@ -125,20 +125,31 @@ def exchange_gather_hot(
     num_shards: int,
     axis_name: str,
     staged_resp: Optional[jnp.ndarray] = None,
+    staged_rows: Optional[jnp.ndarray] = None,
+    staged_slots: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Tiered gather; call inside ``shard_map``.
 
     Same collective round-trip as :func:`exchange_gather`, but the serving
-    shard answers hot requests (``local < hot_per_shard``) from HBM and —
-    when ``staged_resp`` is given — cold requests from the **responder-
-    side staged block**: ``staged_resp[j]`` holds the host-gathered cold
-    row for request slot ``j`` of THIS shard (produced by
+    shard answers hot requests (``local < hot_per_shard``) from HBM and
+    cold requests from host-staged rows (produced by
     :func:`route_cold_requests` + :meth:`HostColdStore.serve`).  Because
     every shard serves only rows it owns, each pod host stages only its
     own shards' cold rows — the multi-host seam the reference's
     UnifiedTensor UVA reads provided on a single node
-    (unified_tensor.cu:202-311).  Without ``staged_resp`` cold rows come
-    back as zeros (fill them via the legacy :func:`merge_cold` overlay).
+    (unified_tensor.cu:202-311).
+
+    Two staged forms:
+      * **compact** (preferred): ``staged_rows`` ``[cold_cap, d]`` +
+        ``staged_slots`` ``[cold_cap]`` request-slot indices (-1 pad),
+        scattered into the response — host->device bytes scale with the
+        actual cold traffic, not the worst-case request matrix
+        (:func:`compact_cold_requests`);
+      * **dense** (legacy): ``staged_resp`` ``[num_shards * b, d]``, one
+        row per request slot.
+
+    Without either, cold rows come back as zeros (fill them via the
+    legacy :func:`merge_cold` overlay).
     """
     b = ids.shape[0]
     d = hot_rows.shape[-1]
@@ -153,7 +164,13 @@ def exchange_gather_hot(
     local = requests - my_rank * nodes_per_shard
     ok = (local >= 0) & (local < hot_per_shard) & (requests >= 0)
     got = jnp.take(hot_rows, jnp.where(ok, local, 0), axis=0, mode="clip")
-    if staged_resp is None:
+    if staged_rows is not None:
+        # Compact scatter: cold slots are disjoint from hot slots; -1
+        # pad slots are dropped as out-of-bounds (no copy, no trash row).
+        got = jnp.where(ok[:, None], got, 0)
+        idx = jnp.where(staged_slots >= 0, staged_slots, num_shards * b)
+        got = got.at[idx].set(staged_rows.astype(got.dtype), mode="drop")
+    elif staged_resp is None:
         got = jnp.where(ok[:, None], got, 0)
     else:
         # Hot slots from HBM, cold slots from the staged host rows
@@ -165,6 +182,29 @@ def exchange_gather_hot(
         tiled=False).reshape(num_shards * b, d)
     out = resp[jnp.clip(routing.slot, 0, num_shards * b - 1)]
     return jnp.where(routing.valid[:, None], out, 0)
+
+
+def compact_cold_requests(cold_req: jnp.ndarray, cold_cap: int):
+    """Compress a responder-side cold-request vector to ``cold_cap`` slots.
+
+    ``cold_req``: ``[R]`` local cold row ids from
+    :func:`route_cold_requests` (-1 = not cold).  Returns ``(slots, ids,
+    dropped)``: request-slot indices and local cold ids (``[cold_cap]``,
+    -1 padded) plus the count of cold requests past the cap (served as
+    zero rows — monitor and raise ``cold_cap`` if ever nonzero).  The
+    host then gathers ``ids`` only: staged host->device bytes drop from
+    the dense ``R = num_shards * node_cap`` rows to ``cold_cap`` (the
+    capacity-bounding trick of the sampler exchange applied to the
+    feature tier).
+    """
+    is_cold = cold_req >= 0
+    order = jnp.argsort(~is_cold, stable=True)   # cold slots first
+    slots = order[:cold_cap].astype(jnp.int32)
+    ids = cold_req[slots]
+    slots = jnp.where(ids >= 0, slots, -1)
+    dropped = jnp.maximum(
+        jnp.sum(is_cold.astype(jnp.int32)) - cold_cap, 0)
+    return slots, ids, dropped
 
 
 def route_cold_requests(

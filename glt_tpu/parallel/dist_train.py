@@ -131,27 +131,31 @@ def make_tiered_train_step(
 ):
     """Build the train half of the tiered two-stage pipeline.
 
-    Returns ``train(state, out, staged_resp, key) -> (state, loss, acc)``
+    Returns ``train(state, out, staged, key) -> (state, loss, acc)``
     where ``out`` is the sample stage's per-shard :class:`SamplerOutput`
-    and ``staged_resp`` is the responder-side ``[S, S * node_cap, d]``
-    cold-row block: shard ``s``'s slice holds host-gathered rows for the
-    cold requests ROUTED TO ``s`` (:func:`route_cold_requests` +
+    and ``staged = (rows, slots)`` is the COMPACT responder-side cold
+    staging: shard ``s``'s ``rows[s] [cold_cap, d]`` hold host-gathered
+    cold rows for its incoming request slots ``slots[s]``
+    (:func:`route_cold_requests` -> :func:`compact_cold_requests` ->
     :meth:`HostColdStore.serve`), so each pod host stages only rows its
-    own shards own.  Hot rows ride the in-jit all-to-all; cold rows join
-    them in the response leg — the per-row HBM/host split the reference's
+    own shards own and host->device bytes scale with actual cold traffic.
+    Hot rows ride the in-jit all-to-all; cold rows are scattered into the
+    response leg — the per-row HBM/host split the reference's
     UnifiedTensor makes inside its gather kernel (unified_tensor.cu:48-81).
     """
     gspec = P(axis_name)
 
-    def local_body(hot_rows, labels_blk, out, staged_resp, params, key):
+    def local_body(hot_rows, labels_blk, out, staged_rows, staged_slots,
+                   params, key):
         hot_rows, labels_blk = hot_rows[0], labels_blk[0]
-        staged_resp = staged_resp[0]
+        staged_rows, staged_slots = staged_rows[0], staged_slots[0]
         out = jax.tree.map(lambda x: x[0], out)
         key = jax.random.fold_in(key, lax.axis_index(axis_name))
 
         x = exchange_gather_hot(out.node, hot_rows, f.nodes_per_shard,
                                 f.hot_per_shard, f.num_shards, axis_name,
-                                staged_resp=staged_resp)
+                                staged_rows=staged_rows,
+                                staged_slots=staged_slots)
         y = exchange_gather(out.node, labels_blk[:, None].astype(jnp.int32),
                             g.nodes_per_shard, g.num_shards, axis_name)[:, 0]
         y = jnp.where(out.node >= 0, y, PADDING_ID)
@@ -171,22 +175,23 @@ def make_tiered_train_step(
 
     shard_fn = jax.shard_map(
         local_body, mesh=mesh,
-        in_specs=(gspec, gspec, gspec, gspec, P(), P()),
+        in_specs=(gspec, gspec, gspec, gspec, gspec, P(), P()),
         out_specs=(P(), P(), P()),
         check_vma=False)
 
     # Global arrays as jit arguments (multi-host: no closure capture).
     @jax.jit
-    def _train(hot_rows, labels_blk, state: TrainState, out, staged_resp,
-               key: jax.Array):
-        loss, acc, grads = shard_fn(hot_rows, labels_blk, out, staged_resp,
-                                    state.params, key)
+    def _train(hot_rows, labels_blk, state: TrainState, out, staged_rows,
+               staged_slots, key: jax.Array):
+        loss, acc, grads = shard_fn(hot_rows, labels_blk, out, staged_rows,
+                                    staged_slots, state.params, key)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss, acc
 
-    def train(state: TrainState, out, staged_resp, key: jax.Array):
-        return _train(f.hot, labels, state, out, staged_resp, key)
+    def train(state: TrainState, out, staged, key: jax.Array):
+        rows, slots = staged
+        return _train(f.hot, labels, state, out, rows, slots, key)
 
     return train
 
@@ -207,64 +212,107 @@ class TieredTrainPipeline:
     def __init__(self, sampler: DistNeighborSampler,
                  train_step, f: TieredShardedFeature, mesh: Mesh,
                  axis_name: str = "shard",
-                 cold_store: Optional[HostColdStore] = None):
+                 cold_store: Optional[HostColdStore] = None,
+                 cold_cap: Optional[int] = None):
         import concurrent.futures
 
         from . import multihost
+        from .dist_feature import compact_cold_requests
 
         self.sampler = sampler
         self.train_step = train_step
         self.f = f
         self.mesh = mesh
         self.axis_name = axis_name
+        # Compact staging capacity: cold rows staged per responder shard
+        # per batch.  Worst case is S * node_cap (every request cold and
+        # aimed at one shard); the typical per-responder load is ~the
+        # node capacity itself, so alpha=2 over it keeps drops rare.
+        # Overflowed requests are served as zeros and counted in
+        # ``last_dropped`` — raise cold_cap if it is ever nonzero.
+        self.cold_cap = (2 * sampler.node_capacity if cold_cap is None
+                         else int(cold_cap))
         # This process's contiguous shard block (all shards when
         # single-process); the cold store serves exactly these.
         self._local = multihost.local_shard_range(mesh, axis_name)
         self.cold_store = cold_store or HostColdStore(
             f, shard_ids=self._local)
-        self._cold_spec = jax.sharding.NamedSharding(mesh, P(axis_name))
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="glt-cold-stage")
+        self.last_dropped = None     # [S] device counts, latest batch
+        self._pending_dropped = []   # unreduced per-batch device counts
+        self.dropped_total = 0       # host sum over all staged batches
         gspec = P(axis_name)
-        self._route = jax.jit(jax.shard_map(
-            lambda nodes: route_cold_requests(
+
+        def route_body(nodes):
+            req = route_cold_requests(
                 nodes[0], f.nodes_per_shard, f.hot_per_shard,
-                f.num_shards, axis_name)[None],
-            mesh=mesh, in_specs=(gspec,), out_specs=gspec,
-            check_vma=False))
+                f.num_shards, axis_name)
+            slots, ids, dropped = compact_cold_requests(req, self.cold_cap)
+            return slots[None], ids[None], dropped[None]
+
+        self._route = jax.jit(jax.shard_map(
+            route_body, mesh=mesh, in_specs=(gspec,),
+            out_specs=(gspec, gspec, gspec), check_vma=False))
 
     def _stage_cold_async(self, out):
         """Submit the cold staging for ``out.node``; returns a future.
 
-        Route (in-jit id all_to_all) -> per-shard host gather from this
-        host's cold store -> per-host feed of the responder-side block.
-        Each process serves only its local shards (all of them in the
+        Route + compact (in-jit all_to_all) -> per-shard host gather of
+        ONLY the compacted cold ids -> per-host feed of the
+        ``[S, cold_cap, d]`` staged rows + their slot indices.  Each
+        process serves only its local shards (all of them in the
         single-process emulation) and feeds only its slab of the global
-        staged array — remote slabs are produced by their own hosts.
+        staged arrays — remote slabs are produced by their own hosts.
         """
         from . import multihost
 
-        cold_req = self._route(out.node)
+        slots, ids, dropped = self._route(out.node)
+        self.last_dropped = dropped
+        # Accumulate lazily (device scalars; reduced on flush) so the
+        # documented contract — "raise cold_cap if drops are ever
+        # nonzero" — is checkable over a whole epoch, not just the last
+        # batch, without a per-batch host sync.
+        self._pending_dropped.append(dropped)
+        if len(self._pending_dropped) >= 64:
+            self.flush_dropped()
 
         def work():
-            # Fetch only this host's addressable request rows (waits on
-            # the route stage only).
-            shards = sorted(cold_req.addressable_shards,
+            # Fetch only this host's addressable id rows (waits on the
+            # route stage only).
+            shards = sorted(ids.addressable_shards,
                             key=lambda sh: sh.index[0].start or 0)
             req = np.concatenate([np.asarray(sh.data) for sh in shards])
             staged = np.zeros(
-                (len(self._local), req.shape[1], self.cold_store.dim),
+                (len(self._local), self.cold_cap, self.cold_store.dim),
                 self.cold_store.dtype)
             for j, s in enumerate(self._local):
                 staged[j] = self.cold_store.serve(s, req[j])
-            return multihost.assemble_global(staged, self.mesh,
+            rows = multihost.assemble_global(staged, self.mesh,
                                              self.axis_name)
+            return rows, slots
         return self._pool.submit(work)
+
+    def flush_dropped(self) -> int:
+        """Reduce pending per-batch drop counters into ``dropped_total``."""
+        import numpy as np
+
+        for d in self._pending_dropped:
+            shards = getattr(d, "addressable_shards", None)
+            if shards is not None:
+                self.dropped_total += int(sum(
+                    np.asarray(sh.data).sum() for sh in shards))
+            else:
+                self.dropped_total += int(np.asarray(d).sum())
+        self._pending_dropped.clear()
+        return self.dropped_total
 
     def run_epoch(self, state: TrainState, seed_batches, key: jax.Array):
         """Drive one epoch; ``seed_batches``: iterable of ``[S, B]`` seeds.
 
         Returns ``(state, losses, accs)`` (device scalars, unsynced).
+        Check ``flush_dropped()`` after the epoch: nonzero means some
+        cold requests overflowed ``cold_cap`` and trained on zero rows.
         """
         from . import multihost
 

@@ -230,6 +230,96 @@ class TestTieredFeature:
         np.testing.assert_allclose(np.asarray(tier), np.asarray(full),
                                    rtol=1e-6)
 
+    def test_compact_staging_matches_dense(self, part_dir):
+        """Compact cold staging (rows + slot scatter) produces the same
+        gather as the dense per-slot staged block, with host->device
+        bytes bounded by cold_cap instead of S * node_cap."""
+        from glt_tpu.parallel import HostColdStore, route_cold_requests
+        from glt_tpu.parallel.dist_feature import compact_cold_requests
+
+        root, _, _, labels = part_dir
+        ds_full = DistDataset.load(root, hot_ratio=1.0, labels=labels)
+        ds_tier = DistDataset.load(root, hot_ratio=0.25, labels=labels)
+        f_full, f_tier = ds_full.feature, ds_tier.feature
+        mesh = _mesh()
+        c, h = f_tier.nodes_per_shard, f_tier.hot_per_shard
+
+        rng = np.random.default_rng(5)
+        ids = np.full((N_DEV, 16), -1, np.int64)
+        for s in range(N_DEV):
+            ids[s, :12] = ds_tier.translate(rng.choice(N, 12, replace=False))
+        ids_j = jnp.asarray(ids, jnp.int32)
+        gspec = P("shard")
+        cold_cap = 24
+
+        def route_body(nodes):
+            req = route_cold_requests(nodes[0], c, h, N_DEV, "shard")
+            slots, cids, dropped = compact_cold_requests(req, cold_cap)
+            return slots[None], cids[None], dropped[None]
+
+        slots, cids, dropped = jax.jit(jax.shard_map(
+            route_body, mesh=mesh, in_specs=(gspec,),
+            out_specs=(gspec, gspec, gspec), check_vma=False))(ids_j)
+        assert (np.asarray(dropped) == 0).all()
+
+        store = HostColdStore(f_tier)
+        req = np.asarray(cids)
+        staged = np.stack([store.serve(s, req[s]) for s in range(N_DEV)])
+        assert (staged != 0).any()
+
+        def tier_body(hot, ids, rows, sl):
+            return exchange_gather_hot(ids[0], hot[0], c, h, N_DEV,
+                                       "shard", staged_rows=rows[0],
+                                       staged_slots=sl[0])[None]
+
+        def full_body(rows, ids):
+            return exchange_gather(ids[0], rows[0], c, N_DEV, "shard")[None]
+
+        tier = jax.jit(jax.shard_map(
+            tier_body, mesh=mesh, in_specs=(gspec,) * 4,
+            out_specs=gspec, check_vma=False))(
+                f_tier.hot, ids_j, jnp.asarray(staged), slots)
+        full = jax.jit(jax.shard_map(
+            full_body, mesh=mesh, in_specs=(gspec, gspec), out_specs=gspec,
+            check_vma=False))(f_full.rows, ids_j)
+        np.testing.assert_allclose(np.asarray(tier), np.asarray(full),
+                                   rtol=1e-6)
+
+    def test_compact_staging_overflow_counts_and_zeros(self, part_dir):
+        """Cold requests past cold_cap are dropped to zero rows (never
+        garbage) and counted."""
+        from glt_tpu.parallel import route_cold_requests
+        from glt_tpu.parallel.dist_feature import compact_cold_requests
+
+        root, _, _, labels = part_dir
+        ds_tier = DistDataset.load(root, hot_ratio=0.25, labels=labels)
+        f_tier = ds_tier.feature
+        mesh = _mesh()
+        c, h = f_tier.nodes_per_shard, f_tier.hot_per_shard
+        gspec = P("shard")
+
+        # Every shard requests ITS OWN cold rows (local, no spread): the
+        # responder-side cold count per shard ~= the request width.
+        ids = np.full((N_DEV, 12), -1, np.int64)
+        for s in range(N_DEV):
+            ids[s] = s * c + h + (np.arange(12) % (c - h))
+        ids_j = jnp.asarray(ids, jnp.int32)
+        cap_small = 4
+
+        def route_body(nodes):
+            req = route_cold_requests(nodes[0], c, h, N_DEV, "shard")
+            slots, cids, dropped = compact_cold_requests(req, cap_small)
+            return slots[None], cids[None], dropped[None]
+
+        slots, cids, dropped = jax.jit(jax.shard_map(
+            route_body, mesh=mesh, in_specs=(gspec,),
+            out_specs=(gspec, gspec, gspec), check_vma=False))(ids_j)
+        # 12 unique-ish cold requests per shard, cap 4 -> drops counted.
+        dropped = np.asarray(dropped)
+        assert (dropped > 0).all()
+        cids = np.asarray(cids)
+        assert ((cids >= 0).sum(axis=1) <= cap_small).all()
+
     def test_tiered_pipeline_loss_drops(self, part_dir):
         root, _, _, labels = part_dir
         ds = DistDataset.load(root, hot_ratio=0.25, labels=labels)
