@@ -1,6 +1,17 @@
-from .sharding import ShardedGraph, ShardedFeature, shard_graph, shard_feature
+from . import multihost
+from .sharding import (
+    ShardedGraph,
+    ShardedFeature,
+    shard_bounds,
+    shard_graph,
+    shard_graph_blocks,
+    shard_feature,
+)
 from .dist_sampler import (
     DistNeighborSampler,
+    bounded_remote_cap,
+    build_sorted_edge_view,
+    dist_edge_exists,
     dist_node_subgraph,
     dist_sample_multi_hop,
     exchange_one_hop,
@@ -9,6 +20,7 @@ from .dist_feature import (
     TieredShardedFeature,
     HostColdStore,
     cold_gather_host,
+    compact_cold_requests,
     route_cold_requests,
     exchange_gather,
     exchange_gather_hot,
@@ -27,6 +39,13 @@ from .dist_train import (
 __all__ = [
     "DistHeteroNeighborSampler",
     "DistNeighborSampler",
+    "bounded_remote_cap",
+    "build_sorted_edge_view",
+    "compact_cold_requests",
+    "dist_edge_exists",
+    "multihost",
+    "shard_bounds",
+    "shard_graph_blocks",
     "shard_hetero_graph",
     "ShardedFeature",
     "ShardedGraph",
