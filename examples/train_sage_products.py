@@ -52,6 +52,10 @@ def main():
     # --no-pipelined runs the two-program loader path.
     ap.add_argument("--pipelined", action=argparse.BooleanOptionalAction,
                     default=True)
+    # Exact final-hop dedup is the default; --no-last-hop-dedup opts into
+    # the leaf-block fast mode (tree-unrolled GraphSAGE semantics).
+    ap.add_argument("--last-hop-dedup",
+                    action=argparse.BooleanOptionalAction, default=True)
     ap.add_argument("--data-root", default=None,
                     help="dir holding converted real datasets "
                          "(scripts/convert_ogb.py); overrides "
@@ -71,7 +75,8 @@ def main():
         sampler = NeighborSampler(ds.get_graph(), args.fanout,
                                   batch_size=args.batch_size,
                                   frontier_cap=args.frontier_cap,
-                                  with_edge=False)
+                                  with_edge=False,
+                                  last_hop_dedup=args.last_hop_dedup)
         feat = ds.get_node_feature()
         labels = np.asarray(ds.get_node_label())
         x0 = jax.numpy.zeros((sampler.node_capacity, feat.shape[1]),
@@ -94,7 +99,8 @@ def main():
     else:
         loader = NeighborLoader(ds, args.fanout, train_idx,
                                 batch_size=args.batch_size, shuffle=True,
-                                frontier_cap=args.frontier_cap)
+                                frontier_cap=args.frontier_cap,
+                                last_hop_dedup=args.last_hop_dedup)
         first = next(iter(loader))
         state = create_train_state(model, jax.random.PRNGKey(0), first, tx)
         step = make_train_step(model, tx, batch_size=args.batch_size)

@@ -205,6 +205,14 @@ def shard_graph_global(topo: CSRTopo, mesh: Mesh,
         nodes_per_shard=c, num_nodes=topo.num_nodes, num_shards=num_shards)
 
 
+def shard_hetero_graph_global(topos, mesh: Mesh,
+                              axis_name: str = "shard"):
+    """Hetero analog of :func:`shard_graph_global`: every edge type's CSR
+    sharded by its source type's ranges, each fed per host."""
+    return {et: shard_graph_global(t, mesh, axis_name)
+            for et, t in topos.items()}
+
+
 def shard_feature_global(feature: np.ndarray, mesh: Mesh,
                          axis_name: str = "shard",
                          dtype=None) -> ShardedFeature:

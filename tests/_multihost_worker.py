@@ -80,6 +80,65 @@ def run_steps(mesh, num_steps: int):
     return losses
 
 
+def run_hetero_steps(mesh, num_steps: int):
+    """Hetero fused train steps over a process-spanning mesh.
+
+    The bipartite user/item fixture of dryrun_multichip; graph + per-type
+    features + labels all fed per host (multihost.shard_hetero_graph_global
+    / shard_feature_global / labels_global).
+    """
+    import jax
+    import numpy as np
+    import optax
+
+    from glt_tpu.data.topology import CSRTopo
+    from glt_tpu.models.rgat import RGAT
+    from glt_tpu.parallel import DistHeteroNeighborSampler, multihost
+    from glt_tpu.parallel.dist_train import (
+        init_hetero_dist_state,
+        make_hetero_dist_train_step,
+    )
+
+    n_dev = mesh.devices.size
+    U, I, classes = 8 * n_dev, 4 * n_dev, 4
+    labels_u = (np.arange(U) % classes).astype(np.int32)
+    u_src = np.repeat(np.arange(U), 2)
+    i_dst = np.concatenate([[u % I, (u + 1) % I] for u in range(U)])
+    et_ui = ("user", "clicks", "item")
+    et_iu = ("item", "rev_clicks", "user")
+    topos = {et_ui: CSRTopo(np.stack([u_src, i_dst]), num_nodes=U),
+             et_iu: CSRTopo(np.stack([i_dst, u_src]), num_nodes=I)}
+    sharded = multihost.shard_hetero_graph_global(topos, mesh)
+    feats = {"user": multihost.shard_feature_global(
+                 np.eye(classes, dtype=np.float32)[labels_u], mesh),
+             "item": multihost.shard_feature_global(
+                 np.eye(classes, dtype=np.float32)[
+                     np.arange(I) % classes], mesh)}
+    lab_u = multihost.labels_global(labels_u, mesh,
+                                    feats["user"].nodes_per_shard)
+
+    batch_size = 4
+    hsamp = DistHeteroNeighborSampler(sharded, mesh, [2, 2], "user",
+                                      batch_size=batch_size,
+                                      frontier_cap=16, seed=0)
+    model = RGAT(edge_types=[et_iu, et_ui], hidden_features=8,
+                 out_features=classes, target_type="user", num_layers=2,
+                 conv="gat", dropout_rate=0.0)
+    tx = optax.adam(1e-3)
+    state = init_hetero_dist_state(model, tx, hsamp, feats,
+                                   jax.random.PRNGKey(4))
+    step = make_hetero_dist_train_step(model, tx, hsamp, feats, lab_u,
+                                       mesh, batch_size=batch_size)
+    seeds = np.stack([np.arange(s * 8, s * 8 + batch_size)
+                      for s in range(n_dev)]).astype(np.int32)
+    losses = []
+    for i in range(num_steps):
+        sd = multihost.feed_seeds(seeds, mesh)
+        state, loss, _ = step(state, sd, jax.random.PRNGKey(5 + i))
+        losses.append(float(np.asarray(jax.device_get(loss))))
+    return losses
+
+
 def make_partition_dir(part_dir: str, n_total_devices: int) -> None:
     """Partition the fixture graph (graph + features) into ``part_dir``."""
     from glt_tpu.partition import RandomPartitioner
@@ -156,6 +215,8 @@ def main():
     mesh = multihost.global_mesh()
     if mode.startswith("dataset:"):
         losses = run_dataset_steps(mesh, steps, mode.split(":", 1)[1])
+    elif mode == "hetero":
+        losses = run_hetero_steps(mesh, steps)
     else:
         losses = run_steps(mesh, steps)
     print(json.dumps({"proc": proc_id, "losses": losses}), flush=True)

@@ -78,6 +78,21 @@ def test_two_process_fleet_matches_single_process(single_process_losses):
     assert per_proc[0][-1] < per_proc[0][0]
 
 
+def test_two_process_hetero_matches_single_process():
+    """Hetero fused step (per-edge-type sharded CSRs, per-type feature
+    exchange, R-GAT) over a process-spanning mesh."""
+    from jax.sharding import Mesh
+
+    from _multihost_worker import run_hetero_steps
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shard",))
+    ref = run_hetero_steps(mesh, 2)
+
+    per_proc = _spawn_fleet(nproc=2, ndev=4, steps=2, mode="hetero")
+    assert per_proc[0] == pytest.approx(per_proc[1], rel=0, abs=0)
+    assert per_proc[0] == pytest.approx(ref, rel=1e-5)
+
+
 def test_two_process_dataset_load_matches_single_process(tmp_path):
     """Per-host DistDataset.load(mesh=...) + tiered pipeline: 2-process
     fleet and single-process run load the same partitions and train to
