@@ -22,23 +22,85 @@ from glt_tpu.models import GraphSAGE
 from glt_tpu.sampler import NegativeSampling
 
 
+def unsup_dot_loss(z, meta):
+    """Binary CE on seed-edge embedding dot products (the reference's
+    unsupervised objective)."""
+    eli = meta["edge_label_index"]
+    label = meta["edge_label"]
+    valid = (eli[0] >= 0) & (eli[1] >= 0) & (label >= 0)
+    src = z[jnp.clip(eli[0], 0, z.shape[0] - 1)]
+    dst = z[jnp.clip(eli[1], 0, z.shape[0] - 1)]
+    logits = (src * dst).sum(-1)
+    y = (label > 0).astype(jnp.float32)
+    ce = optax.sigmoid_binary_cross_entropy(logits, y)
+    return jnp.where(valid, ce, 0).sum() / jnp.maximum(valid.sum(), 1)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=0.1)
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--batch-size", type=int, default=256)
     ap.add_argument("--fanout", type=int, nargs="+", default=[10, 10])
+    # G link batches per device program (amortises dispatch — the small
+    # batches here are dispatch-bound); 0 = per-batch loader loop.
+    ap.add_argument("--group", type=int, default=8)
     args = ap.parse_args()
 
     ds, edge_index = synthetic_ppi(scale=args.scale)
-    loader = LinkNeighborLoader(
-        ds, args.fanout, edge_index, batch_size=args.batch_size,
-        neg_sampling=NegativeSampling("binary", 1), shuffle=True,
-        frontier_cap=4096)
-
     model = GraphSAGE(hidden_features=64, out_features=64, num_layers=2,
                       dropout_rate=0.0)
     tx = optax.adam(1e-3)
+    neg = NegativeSampling("binary", 1)
+
+    if args.group > 0:
+        from glt_tpu.models import (
+            link_seed_blocks,
+            make_scanned_link_train_step,
+        )
+        from glt_tpu.sampler import NeighborSampler
+
+        sampler = NeighborSampler(ds.get_graph(), args.fanout,
+                                  batch_size=args.batch_size,
+                                  frontier_cap=4096, with_edge=False)
+        feat = ds.get_node_feature()
+        cap = 4 * sampler.batch_size  # binary seed union width
+        import glt_tpu.sampler.neighbor_sampler as ns
+
+        seed_width = 4 * args.batch_size
+        ecap_widths = ns.hop_widths(seed_width, args.fanout, 4096)
+        x0 = jnp.zeros((ns.max_sampled_nodes(seed_width, args.fanout, 4096),
+                        feat.shape[1]), jnp.float32)
+        ecap = sum(w * f for w, f in zip(ecap_widths, args.fanout))
+        ei0 = jnp.full((2, ecap), -1, jnp.int32)
+        m0 = jnp.zeros((ecap,), bool)
+        params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
+        opt_state = tx.init(params)
+        step = make_scanned_link_train_step(model, tx, sampler, feat,
+                                            unsup_dot_loss, neg,
+                                            group=args.group)
+        rng = np.random.default_rng(0)
+
+        for epoch in range(args.epochs):
+            t0 = time.perf_counter()
+            losses, batches = [], 0
+            for sb, db, nb in link_seed_blocks(edge_index, args.batch_size,
+                                               args.group, rng):
+                params, opt_state, ls = step(
+                    params, opt_state, sb, db,
+                    jax.random.fold_in(jax.random.PRNGKey(epoch), batches))
+                losses.append(ls[:nb])
+                batches += nb
+            jax.device_get(losses[-1])
+            mean = float(np.mean(np.concatenate(
+                [np.asarray(jax.device_get(l)) for l in losses])))
+            print(f"epoch {epoch}: loss={mean:.4f} "
+                  f"time={time.perf_counter() - t0:.2f}s")
+        return
+
+    loader = LinkNeighborLoader(
+        ds, args.fanout, edge_index, batch_size=args.batch_size,
+        neg_sampling=neg, shuffle=True, frontier_cap=4096)
     first = next(iter(loader))
     params = model.init({"params": jax.random.PRNGKey(0)}, first.x,
                         first.edge_index, first.edge_mask)
@@ -46,19 +108,9 @@ def main():
 
     @jax.jit
     def step(params, opt_state, batch):
-        eli = batch.metadata["edge_label_index"]
-        label = batch.metadata["edge_label"]
-
         def loss_fn(p):
             z = model.apply(p, batch.x, batch.edge_index, batch.edge_mask)
-            valid = (eli[0] >= 0) & (eli[1] >= 0) & (label >= 0)
-            src = z[jnp.clip(eli[0], 0, z.shape[0] - 1)]
-            dst = z[jnp.clip(eli[1], 0, z.shape[0] - 1)]
-            logits = (src * dst).sum(-1)
-            y = (label > 0).astype(jnp.float32)
-            ce = optax.sigmoid_binary_cross_entropy(logits, y)
-            return jnp.where(valid, ce, 0).sum() / jnp.maximum(
-                valid.sum(), 1)
+            return unsup_dot_loss(z, batch.metadata)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state, params)

@@ -7,7 +7,9 @@ from .train import (
     TrainState,
     create_train_state,
     make_eval_step,
+    link_seed_blocks,
     make_pipelined_train_step,
+    make_scanned_link_train_step,
     make_train_step,
     run_pipelined_epoch,
     seed_cross_entropy,
@@ -24,8 +26,10 @@ __all__ = [
     "SAGEConv",
     "TrainState",
     "create_train_state",
+    "link_seed_blocks",
     "make_eval_step",
     "make_pipelined_train_step",
+    "make_scanned_link_train_step",
     "make_train_step",
     "run_pipelined_epoch",
     "scatter_mean",
