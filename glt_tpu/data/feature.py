@@ -68,6 +68,20 @@ class Feature:
         self._id2index_np = (
             None if id2index is None else np.asarray(id2index, np.int32))
         self._host_full = feature_array  # for cpu_get / save paths
+        self._gather_jit = None
+
+    @staticmethod
+    def _gather_hot_impl(hot, id2index, ids):
+        from ..ops.gather_pallas import gather_rows
+
+        valid = ids >= 0
+        idx = jnp.where(valid, ids, 0)
+        if id2index is not None:
+            idx = id2index[idx]
+        # XLA gather (measured 2x the Pallas DMA kernel; see
+        # ops/gather_pallas.py docstring).
+        rows = gather_rows(hot, idx)
+        return jnp.where(valid[:, None], rows, 0)
 
     # -- shape info --------------------------------------------------------
     @property
@@ -101,17 +115,16 @@ class Feature:
         it before the jitted train step).  Padding rows are zeros.
         """
         if self._cold.shape[0] == 0:
-            from ..ops.gather_pallas import gather_rows
-
-            ids = jnp.asarray(ids, jnp.int32)
-            valid = ids >= 0
-            idx = jnp.where(valid, ids, 0)
-            if self._id2index is not None:
-                idx = self._id2index[idx]
-            # XLA gather (measured 2x the Pallas DMA kernel; see
-            # ops/gather_pallas.py docstring).
-            rows = gather_rows(self._hot, idx)
-            return jnp.where(valid[:, None], rows, 0)
+            if isinstance(ids, jax.core.Tracer):
+                # Already inside an enclosing jit: trace inline.
+                return self._gather_hot_impl(self._hot, self._id2index,
+                                             jnp.asarray(ids, jnp.int32))
+            # Eager call sites (loader collate): ONE fused dispatch
+            # instead of per-op dispatches (tunnel-latency bound).
+            if self._gather_jit is None:
+                self._gather_jit = jax.jit(self._gather_hot_impl)
+            return self._gather_jit(self._hot, self._id2index,
+                                    jnp.asarray(ids, jnp.int32))
 
         if isinstance(ids, jax.core.Tracer):
             raise ValueError(

@@ -10,6 +10,7 @@ from .train import (
     link_seed_blocks,
     make_pipelined_train_step,
     make_scanned_link_train_step,
+    make_scanned_subgraph_train_step,
     make_train_step,
     run_pipelined_epoch,
     seed_cross_entropy,
@@ -30,6 +31,7 @@ __all__ = [
     "make_eval_step",
     "make_pipelined_train_step",
     "make_scanned_link_train_step",
+    "make_scanned_subgraph_train_step",
     "make_train_step",
     "run_pipelined_epoch",
     "scatter_mean",

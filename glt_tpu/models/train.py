@@ -303,6 +303,95 @@ def make_scanned_link_train_step(model, tx, sampler, rows, loss_fn,
     return step
 
 
+def make_scanned_subgraph_train_step(model, tx, sampler, rows, loss_fn,
+                                     max_degree: int):
+    """ONE jitted program trains a block of induced-subgraph batches.
+
+    Per batch — hop expansion, induced extraction
+    (:func:`~glt_tpu.ops.subgraph.node_subgraph`), feature gather,
+    fwd/bwd, update — under ``lax.scan`` (scan length = the seed block's
+    leading axis); the SEAL-style configs run tiny batches where per-call
+    dispatch/transfer dominates, so G-batching (plus device-resident seed
+    blocks) moves epoch time the same way it does for the link path.
+
+    ``loss_fn(z, out, y) -> scalar`` gets node embeddings over the
+    extracted subgraph, the per-batch :class:`SamplerOutput` (graph-
+    direction COO), and the per-batch label block ``y``.  Seeds are
+    DEDUPED in the node list, so positional slicing of ``z`` mispairs
+    whenever a seed repeats — use ``out.metadata['seed_index']``
+    (``[B_seeds]`` local indices of the seed slots, -1 for padding) to
+    locate seed embeddings.
+
+    Returns ``step(params, opt_state, seeds [G, B], y [G, ...], key)``.
+    """
+    import numpy as np
+
+    from ..data.feature import Feature
+    from ..ops.subgraph import node_subgraph
+    from ..ops.unique import relabel_by_reference
+    from ..sampler.base import SamplerOutput
+
+    g = sampler.graph
+    if not isinstance(rows, Feature):
+        rows = Feature(np.asarray(rows))
+    if rows.hot_count < rows.size:
+        raise ValueError("scanned subgraph step needs device-resident rows")
+    if not sampler.last_hop_dedup:
+        # Same guard as NeighborSampler.subgraph(): the induced extract
+        # relabels against a UNIQUE node set.
+        raise ValueError(
+            "scanned subgraph step requires last_hop_dedup=True")
+    hot_rows = rows.hot_rows
+    id2index = rows.id2index
+    k_deg = int(max_degree)
+
+    @jax.jit
+    def run(indptr, indices, eids, sub_eids, rows_arg, params, opt_state,
+            seeds_blk, y_blk, key):
+        def body(carry, inp):
+            params, opt = carry
+            seeds, y, k = inp
+            base = sampler._sample_impl(indptr, indices, eids, seeds, k)
+            sub = node_subgraph(indptr, indices, base.node, k_deg,
+                                edge_ids=sub_eids)
+            ref = base.node[: seeds.shape[0]]
+            out = SamplerOutput(
+                node=base.node, row=sub.rows, col=sub.cols, edge=sub.eids,
+                batch=seeds, node_mask=base.node_mask, edge_mask=sub.mask,
+                num_sampled_nodes=base.num_sampled_nodes,
+                metadata={"seed_index":
+                          relabel_by_reference(ref, seeds)})
+            valid = out.node >= 0
+            gid = jnp.where(valid, out.node, 0)
+            ridx = (gid if id2index is None
+                    else jnp.take(id2index, gid, axis=0, mode="clip"))
+            x = jnp.where(valid[:, None],
+                          jnp.take(rows_arg, ridx, axis=0, mode="clip"), 0)
+            edge_index = jnp.stack([out.row, out.col])
+
+            def lf(p):
+                z = model.apply(p, x, edge_index, out.edge_mask)
+                return loss_fn(z, out, y)
+
+            loss, grads = jax.value_and_grad(lf)(params)
+            updates, opt = tx.update(grads, opt, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt), loss
+
+        keys = jax.random.split(key, seeds_blk.shape[0])
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), (seeds_blk, y_blk, keys))
+        return params, opt_state, losses
+
+    def step(params, opt_state, seeds_blk, y_blk, key):
+        return run(g.indptr, g.indices, g.gather_edge_ids, g.edge_ids,
+                   hot_rows, params, opt_state,
+                   jnp.asarray(seeds_blk, jnp.int32),
+                   jnp.asarray(y_blk), key)
+
+    return step
+
+
 def link_seed_blocks(edge_index, batch_size: int, group: int, rng):
     """Shuffled seed-edge ``[G, q]`` src/dst blocks, -1 padded.
 

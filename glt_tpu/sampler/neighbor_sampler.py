@@ -153,6 +153,7 @@ class NeighborSampler(BaseSampler):
         self._sample_jit = jax.jit(self._sample_impl)
         self._sample_many_jit = {}
         self._sample_edges_jit = {}
+        self._subgraph_jit = {}
 
     # -- key management ----------------------------------------------------
     def _next_key(self) -> jax.Array:
@@ -541,10 +542,33 @@ class NeighborSampler(BaseSampler):
             raise ValueError(
                 "subgraph() requires last_hop_dedup=True: the induced "
                 "extract relabels against a unique node set")
-        base = self.sample_from_nodes(inputs, key=key)
+        ids = inputs.node
+        if isinstance(ids, jax.Array) and ids.shape == (self.batch_size,):
+            seeds = ids.astype(jnp.int32)
+        else:
+            seeds = jnp.asarray(_pad_ids(np.asarray(ids), self.batch_size))
+        if key is None:
+            key = self._next_key()
+        # ONE program: hop expansion + induced extraction.  The eager
+        # composition (sample jit, then op-by-op node_subgraph) paid ~20
+        # per-op dispatches per batch — pure host/tunnel overhead.
+        k = int(max_degree)
+        if k not in self._subgraph_jit:
+            def fused(indptr, indices, hop_eids, sub_eids, seeds, key,
+                      _k=k):
+                base = self._sample_impl(indptr, indices, hop_eids, seeds,
+                                         key)
+                sub = node_subgraph(indptr, indices, base.node, _k,
+                                    edge_ids=sub_eids)
+                return base, sub
+
+            self._subgraph_jit[k] = jax.jit(fused)
         g = self.graph
-        sub = node_subgraph(g.indptr, g.indices, base.node, max_degree,
-                            edge_ids=g.edge_ids)
+        # gather_edge_ids for the hop loop (None when ids are positional
+        # — skips identity gathers); real edge ids for the extract.
+        base, sub = self._subgraph_jit[k](g.indptr, g.indices,
+                                          g.gather_edge_ids, g.edge_ids,
+                                          seeds, key)
         return SamplerOutput(
             node=base.node,
             row=sub.rows,
