@@ -48,6 +48,22 @@ def main():
     ap.add_argument("--fanout", type=int, nargs="+", default=[15, 10, 5])
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--frontier-cap", type=int, default=8192)
+    # Occupancy-sized node capacity (VERDICT r4 #1): calibrate the padded
+    # node buffer to p99 of measured unique-node counts instead of the
+    # zero-dedup worst case — feature gather + train segment ops scale
+    # with the padded width.  Overflow batches (<1% by construction)
+    # train with their excess-node edges masked; the rate is reported.
+    ap.add_argument("--auto-cap", action=argparse.BooleanOptionalAction,
+                    default=True)
+    ap.add_argument("--node-cap", type=int, default=None,
+                    help="explicit padded node capacity (overrides "
+                         "--auto-cap calibration)")
+    ap.add_argument("--cap-batches", type=int, default=24,
+                    help="calibration batches for --auto-cap")
+    # bf16 matmuls (f32 params/aggregation/loss) — the MXU's native mixed
+    # precision; loss-curve parity asserted in tests/test_models.py.
+    ap.add_argument("--bf16", action=argparse.BooleanOptionalAction,
+                    default=True)
     # Fused "train k + sample k+1" single-program pipeline (default);
     # --no-pipelined runs the two-program loader path.
     ap.add_argument("--pipelined", action=argparse.BooleanOptionalAction,
@@ -68,15 +84,35 @@ def main():
 
     ds, train_idx = synthetic_products(scale=args.scale)
     model = GraphSAGE(hidden_features=args.hidden, out_features=47,
-                      num_layers=len(args.fanout))
+                      num_layers=len(args.fanout),
+                      dtype=jax.numpy.bfloat16 if args.bf16 else None)
     tx = optax.adam(1e-3)
+
+    node_cap = args.node_cap
+    if node_cap is None and args.auto_cap:
+        from glt_tpu.sampler import calibrate_node_capacity
+
+        probe = NeighborSampler(ds.get_graph(), args.fanout,
+                                batch_size=args.batch_size,
+                                frontier_cap=args.frontier_cap,
+                                with_edge=False,
+                                last_hop_dedup=args.last_hop_dedup)
+        rng_cal = np.random.default_rng(42)
+        cal = [b for b, _ in zip(
+            seed_batches(train_idx, args.batch_size, rng_cal),
+            range(args.cap_batches))]
+        node_cap = calibrate_node_capacity(probe, cal)
+        print(f"auto-cap: node_capacity {node_cap} "
+              f"({node_cap / probe.full_node_capacity:.0%} of worst-case "
+              f"{probe.full_node_capacity})")
 
     if args.pipelined:
         sampler = NeighborSampler(ds.get_graph(), args.fanout,
                                   batch_size=args.batch_size,
                                   frontier_cap=args.frontier_cap,
                                   with_edge=False,
-                                  last_hop_dedup=args.last_hop_dedup)
+                                  last_hop_dedup=args.last_hop_dedup,
+                                  node_capacity=node_cap)
         feat = ds.get_node_feature()
         labels = np.asarray(ds.get_node_label())
         x0 = jax.numpy.zeros((sampler.node_capacity, feat.shape[1]),
@@ -92,15 +128,25 @@ def main():
         rng = np.random.default_rng(0)
 
         def run_epoch(state, epoch):
-            return run_pipelined_epoch(
+            stats = {} if sampler.capped else None
+            res = run_pipelined_epoch(
                 step, sample_first,
                 seed_batches(train_idx, args.batch_size, rng),
-                state, jax.random.PRNGKey(100 + epoch))
+                state, jax.random.PRNGKey(100 + epoch), stats=stats)
+            if stats and stats.get("overflow_flags"):
+                ovf = int(np.asarray(
+                    jax.device_get(jax.numpy.stack(
+                        stats["overflow_flags"]))).sum())
+                if ovf:
+                    print(f"  overflow batches: {ovf}/"
+                          f"{len(stats['overflow_flags'])}")
+            return res
     else:
         loader = NeighborLoader(ds, args.fanout, train_idx,
                                 batch_size=args.batch_size, shuffle=True,
                                 frontier_cap=args.frontier_cap,
-                                last_hop_dedup=args.last_hop_dedup)
+                                last_hop_dedup=args.last_hop_dedup,
+                                node_capacity=node_cap)
         first = next(iter(loader))
         state = create_train_state(model, jax.random.PRNGKey(0), first, tx)
         step = make_train_step(model, tx, batch_size=args.batch_size)

@@ -49,6 +49,7 @@ class NodeLoader:
         drop_last: bool = False,
         prefetch: int = 2,
         seed: int = 0,
+        overflow_fallback: bool = True,
     ):
         self.data = data
         self.sampler = node_sampler
@@ -60,6 +61,14 @@ class NodeLoader:
         self._rng = np.random.default_rng(seed)
         self._labels_dev = None
         self._epoch = 0
+        # Occupancy-capped samplers flag rare batches whose unique-node
+        # count exceeds the static buffer; strict mode (default) re-runs
+        # those through the exact full-capacity program.  Costs one
+        # device->host scalar fetch per batch — free once the batch is
+        # consumed anyway; set False to defer (flag rides in
+        # batch.metadata, overflow edges are already masked).
+        self.overflow_fallback = bool(overflow_fallback)
+        self.overflow_batches = 0
 
     def __len__(self) -> int:
         n = self.input_nodes.shape[0]
@@ -92,9 +101,25 @@ class NodeLoader:
                 if not pending:
                     return
                 out, nseeds = pending.popleft()
+                out = self._maybe_refetch_overflow(out)
                 yield self._collate_fn(out, nseeds)
         finally:
             pending.clear()
+
+    def _maybe_refetch_overflow(self, out):
+        """Strict overflow fallback: re-sample a flagged batch through the
+        sampler's full-capacity twin (verbatim seeds from ``out.batch``)."""
+        s = self.sampler
+        if (not self.overflow_fallback or not getattr(s, "capped", False)
+                or not out.metadata):
+            return out
+        import jax
+
+        if not bool(np.asarray(jax.device_get(out.metadata["overflow"]))):
+            return out
+        self.overflow_batches += 1
+        return s.full_capacity_sibling().sample_from_nodes(
+            NodeSamplerInput(out.batch))
 
     # -- collate (cf. node_loader.py:85 ``_collate_fn``) -------------------
     def _collate_fn(self, out, num_seeds: int) -> Batch:
@@ -135,15 +160,18 @@ class NeighborLoader(NodeLoader):
         sampler: Optional[NeighborSampler] = None,
         as_pyg_v1: bool = False,
         last_hop_dedup: bool = True,
+        node_capacity: Optional[int] = None,
+        overflow_fallback: bool = True,
     ):
         if sampler is None:
             sampler = NeighborSampler(
                 data.get_graph(), num_neighbors, batch_size=batch_size,
                 frontier_cap=frontier_cap, with_edge=with_edge, seed=seed,
-                last_hop_dedup=last_hop_dedup)
+                last_hop_dedup=last_hop_dedup, node_capacity=node_capacity)
         super().__init__(data, sampler, input_nodes, batch_size=batch_size,
                          shuffle=shuffle, drop_last=drop_last,
-                         prefetch=prefetch, seed=seed)
+                         prefetch=prefetch, seed=seed,
+                         overflow_fallback=overflow_fallback)
         self.num_neighbors = list(num_neighbors)
         self.frontier_cap = frontier_cap
         self.as_pyg_v1 = as_pyg_v1

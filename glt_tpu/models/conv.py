@@ -10,14 +10,27 @@ with -1 padding and an ``edge_mask``, ``edge_index[0]`` = message source
 TPU notes: aggregation is ``jax.ops.segment_sum`` with a spill segment for
 padding edges (XLA lowers this to sorted-scatter, MXU-friendly); all matmuls
 are batched over the padded node dimension so shapes are static.
+
+Mixed precision: every layer takes ``dtype`` (e.g. ``jnp.bfloat16``) — the
+COMPUTE dtype of its Dense matmuls only.  Params stay float32, the MXU
+accumulates in float32 natively, outputs are cast back to float32, and the
+gather/segment aggregation path is untouched (it is lane-tile-bound, not
+precision-bound — see BASELINE.md).  The reference's torch examples train
+in f32 (examples/train_sage_ogbn_products.py); bf16 matmuls are a
+TPU-native win the MXU makes free.
 """
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+
+
+def _mm_dtype(dtype):
+    """Resolve a layer's matmul compute dtype (None = full f32)."""
+    return None if dtype is None else jnp.dtype(dtype)
 
 
 def scatter_sum(msgs: jnp.ndarray, dst: jnp.ndarray, num_nodes: int,
@@ -60,6 +73,7 @@ class SAGEConv(nn.Module):
     """
     out_features: int
     use_bias: bool = True
+    dtype: Any = None   # matmul compute dtype (e.g. bf16); params/agg f32
 
     @nn.compact
     def __call__(self, x, edge_index, edge_mask):
@@ -67,11 +81,12 @@ class SAGEConv(nn.Module):
         src, dst = edge_index[0], edge_index[1]
         msgs = jnp.take(x, jnp.clip(src, 0, num_nodes - 1), axis=0)
         agg = scatter_mean(msgs, dst, num_nodes, edge_mask)
+        dt = _mm_dtype(self.dtype)
         out = (nn.Dense(self.out_features, use_bias=self.use_bias,
-                        name="lin_self")(x)
+                        dtype=dt, name="lin_self")(x)
                + nn.Dense(self.out_features, use_bias=False,
-                          name="lin_nbr")(agg))
-        return out
+                          dtype=dt, name="lin_nbr")(agg))
+        return out if dt is None else out.astype(jnp.float32)
 
 
 class GATConv(nn.Module):
@@ -80,6 +95,7 @@ class GATConv(nn.Module):
     heads: int = 1
     concat: bool = True
     negative_slope: float = 0.2
+    dtype: Any = None   # matmul compute dtype; attention math stays f32
 
     @nn.compact
     def __call__(self, x, edge_index, edge_mask):
@@ -89,7 +105,8 @@ class GATConv(nn.Module):
         src_c = jnp.clip(src, 0, num_nodes - 1)
         dst_c = jnp.clip(dst, 0, num_nodes - 1)
 
-        z = nn.Dense(h * f, use_bias=False, name="lin")(x).reshape(
+        z = nn.Dense(h * f, use_bias=False, dtype=_mm_dtype(self.dtype),
+                     name="lin")(x).astype(jnp.float32).reshape(
             num_nodes, h, f)
         att_src = self.param("att_src", nn.initializers.glorot_uniform(),
                              (h, f))

@@ -1,6 +1,8 @@
 """GAT stack over padded batches."""
 from __future__ import annotations
 
+from typing import Any
+
 from flax import linen as nn
 
 from .conv import GATConv
@@ -12,6 +14,7 @@ class GAT(nn.Module):
     num_layers: int = 2
     heads: int = 4
     dropout_rate: float = 0.5
+    dtype: Any = None   # matmul compute dtype (see conv.py)
 
     @nn.compact
     def __call__(self, x, edge_index, edge_mask, *, train: bool = False):
@@ -19,9 +22,11 @@ class GAT(nn.Module):
             last = i == self.num_layers - 1
             if last:
                 x = GATConv(self.out_features, heads=1, concat=False,
+                            dtype=self.dtype,
                             name=f"conv{i}")(x, edge_index, edge_mask)
             else:
                 x = GATConv(self.hidden_features, heads=self.heads,
+                            dtype=self.dtype,
                             name=f"conv{i}")(x, edge_index, edge_mask)
                 x = nn.elu(x)
                 x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)

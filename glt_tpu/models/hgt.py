@@ -16,13 +16,14 @@ drops into every hetero train step unchanged.
 """
 from __future__ import annotations
 
-from typing import Dict, Sequence, Tuple
+from typing import Any, Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
 from ..typing import as_str
+from .conv import _mm_dtype
 
 
 class HGTConv(nn.Module):
@@ -37,6 +38,7 @@ class HGTConv(nn.Module):
     edge_types: Sequence[Tuple[str, str, str]]
     out_features: int
     heads: int = 2
+    dtype: Any = None   # matmul compute dtype; attention math stays f32
 
     @nn.compact
     def __call__(self, x: Dict[str, jnp.ndarray], edge_index, edge_mask):
@@ -44,10 +46,12 @@ class HGTConv(nn.Module):
         if self.out_features % h:
             raise ValueError("heads must divide out_features")
         d = self.out_features // h
+        dt = _mm_dtype(self.dtype)
 
         def per_type(name):
-            return {t: nn.Dense(h * d, use_bias=False,
-                                name=f"{name}_{t}")(v).reshape(-1, h, d)
+            return {t: nn.Dense(h * d, use_bias=False, dtype=dt,
+                                name=f"{name}_{t}")(v).astype(
+                jnp.float32).reshape(-1, h, d)
                     for t, v in x.items()}
 
         K, Q, V = per_type("k"), per_type("q"), per_type("v")
@@ -112,8 +116,8 @@ class HGTConv(nn.Module):
                     ex / jnp.maximum(denom, 1e-16)[seg], seg,
                     num_segments=n_t + 1)
             self.sow("intermediates", f"att_weight_sum_{t}", att_sum[:n_t])
-            a_out = nn.Dense(self.out_features, name=f"a_{t}")(
-                nn.gelu(agg.reshape(n_t, h * d)))
+            a_out = nn.Dense(self.out_features, dtype=dt, name=f"a_{t}")(
+                nn.gelu(agg.reshape(n_t, h * d))).astype(jnp.float32)
             gate = self.param(f"skip_{t}", nn.initializers.ones, ())
             out[t] = x[t] + jax.nn.sigmoid(gate) * a_out
         # untouched destination types pass through
@@ -130,15 +134,19 @@ class HGT(nn.Module):
     num_layers: int = 2
     heads: int = 2
     dropout_rate: float = 0.5
+    dtype: Any = None   # matmul compute dtype (see conv.py)
 
     @nn.compact
     def __call__(self, x: Dict[str, jnp.ndarray], edge_index, edge_mask, *,
                  train: bool = False):
-        h = {t: nn.Dense(self.hidden_features, name=f"in_{t}")(v)
+        dt = _mm_dtype(self.dtype)
+        h = {t: nn.Dense(self.hidden_features, dtype=dt,
+                         name=f"in_{t}")(v).astype(jnp.float32)
              for t, v in x.items()}
         for i in range(self.num_layers):
             h = HGTConv(self.edge_types, self.hidden_features,
-                        heads=self.heads, name=f"layer{i}")(
+                        heads=self.heads, dtype=self.dtype,
+                        name=f"layer{i}")(
                 h, edge_index, edge_mask)
             if train:
                 h = {t: nn.Dropout(self.dropout_rate,

@@ -7,13 +7,13 @@ type, summed per destination node type, per-type output projections.
 """
 from __future__ import annotations
 
-from typing import Dict, Sequence, Tuple
+from typing import Any, Dict, Sequence, Tuple
 
 import jax.numpy as jnp
 from flax import linen as nn
 
 from ..typing import as_str
-from .conv import GATConv, SAGEConv
+from .conv import GATConv, SAGEConv, _mm_dtype
 
 
 class HeteroConv(nn.Module):
@@ -27,9 +27,11 @@ class HeteroConv(nn.Module):
     out_features: int
     conv: str = "sage"      # 'sage' | 'gat'
     heads: int = 2
+    dtype: Any = None       # matmul compute dtype (see conv.py)
 
     @nn.compact
     def __call__(self, x: Dict[str, jnp.ndarray], edge_index, edge_mask):
+        dt = _mm_dtype(self.dtype)
         outs: Dict[str, list] = {}
         for et in self.edge_types:
             src_t, _, dst_t = et
@@ -43,10 +45,12 @@ class HeteroConv(nn.Module):
             # a homogeneous conv can run on one node array.
             n_dst = x[dst_t].shape[0]
             n_src = x[src_t].shape[0]
-            dsrc = nn.Dense(self.out_features,
-                            name=f"{as_str(et)}_src_proj")(x[src_t])
-            ddst = nn.Dense(self.out_features,
-                            name=f"{as_str(et)}_dst_proj")(x[dst_t])
+            dsrc = nn.Dense(self.out_features, dtype=dt,
+                            name=f"{as_str(et)}_src_proj")(
+                x[src_t]).astype(jnp.float32)
+            ddst = nn.Dense(self.out_features, dtype=dt,
+                            name=f"{as_str(et)}_dst_proj")(
+                x[dst_t]).astype(jnp.float32)
             joint = jnp.concatenate([ddst, dsrc], axis=0)
             ei_shift = jnp.stack([
                 jnp.where(ei[0] >= 0, ei[0] + n_dst, -1),  # src rows shifted
@@ -54,10 +58,10 @@ class HeteroConv(nn.Module):
             ])
             if self.conv == "gat":
                 h = GATConv(self.out_features, heads=self.heads,
-                            concat=False,
+                            concat=False, dtype=self.dtype,
                             name=f"{as_str(et)}_conv")(joint, ei_shift, mask)
             else:
-                h = SAGEConv(self.out_features,
+                h = SAGEConv(self.out_features, dtype=self.dtype,
                              name=f"{as_str(et)}_conv")(joint, ei_shift, mask)
             outs.setdefault(dst_t, []).append(h[:n_dst])
         return {t: sum(hs) for t, hs in outs.items()}
@@ -73,15 +77,19 @@ class RGAT(nn.Module):
     heads: int = 2
     conv: str = "gat"
     dropout_rate: float = 0.5
+    dtype: Any = None       # matmul compute dtype (see conv.py)
 
     @nn.compact
     def __call__(self, x: Dict[str, jnp.ndarray], edge_index, edge_mask, *,
                  train: bool = False):
-        h = {t: nn.Dense(self.hidden_features, name=f"in_{t}")(v)
+        dt = _mm_dtype(self.dtype)
+        h = {t: nn.Dense(self.hidden_features, dtype=dt,
+                         name=f"in_{t}")(v).astype(jnp.float32)
              for t, v in x.items()}
         for i in range(self.num_layers):
             out = HeteroConv(self.edge_types, self.hidden_features,
                              conv=self.conv, heads=self.heads,
+                             dtype=self.dtype,
                              name=f"layer{i}")(h, edge_index, edge_mask)
             # untouched types pass through
             h = {t: nn.relu(out[t]) if t in out else h[t] for t in h}

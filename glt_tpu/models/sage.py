@@ -8,7 +8,7 @@ masked by the loss).
 """
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Any, Sequence
 
 import jax.numpy as jnp
 from flax import linen as nn
@@ -21,13 +21,17 @@ class GraphSAGE(nn.Module):
     out_features: int
     num_layers: int = 3
     dropout_rate: float = 0.5
+    # Matmul compute dtype (e.g. jnp.bfloat16): params, aggregation, loss
+    # all stay f32; only the MXU matmuls run reduced (see conv.py).
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x, edge_index, edge_mask, *, train: bool = False):
         for i in range(self.num_layers):
             last = i == self.num_layers - 1
             dim = self.out_features if last else self.hidden_features
-            x = SAGEConv(dim, name=f"conv{i}")(x, edge_index, edge_mask)
+            x = SAGEConv(dim, dtype=self.dtype,
+                         name=f"conv{i}")(x, edge_index, edge_mask)
             if not last:
                 x = nn.relu(x)
                 x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)

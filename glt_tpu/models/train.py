@@ -174,6 +174,14 @@ def make_pipelined_train_step(model, tx, sampler, rows, labels,
                 out_next)
 
     def step(state: TrainState, out_prev, seeds_next, key_next):
+        if out_prev.metadata is not None:
+            # Strip metadata (the occupancy-cap overflow flag) from the
+            # donated pytree so a caller-retained reference survives the
+            # donation (run_pipelined_epoch collects the flags and fetches
+            # them once per epoch).
+            import dataclasses as _dc
+
+            out_prev = _dc.replace(out_prev, metadata=None)
         return _step(g.indptr, g.indices, g.gather_edge_ids, hot_rows,
                      labels, state, out_prev,
                      jnp.asarray(seeds_next, jnp.int32), key_next)
@@ -182,7 +190,7 @@ def make_pipelined_train_step(model, tx, sampler, rows, labels,
 
 
 def run_pipelined_epoch(step, sample_first, seed_batches, state,
-                        base_key) -> tuple:
+                        base_key, stats: dict = None) -> tuple:
     """Drive one epoch of the fused pipeline.
 
     ``seed_batches``: iterable of ``[batch_size]`` int32 device/host seed
@@ -190,10 +198,16 @@ def run_pipelined_epoch(step, sample_first, seed_batches, state,
     one per batch (every batch is trained exactly once; the final batch's
     train half runs in an epilogue step whose sample half re-samples batch
     0 and is discarded).
+
+    ``stats``: optional dict; with an occupancy-capped sampler,
+    ``stats['overflow_flags']`` collects each batch's device overflow
+    scalar (no per-batch sync — fetch after the epoch and report the
+    rate; overflow batches trained with their excess-node edges masked).
     """
     import jax.numpy as jnp
 
     losses, accs = [], []
+    flags = None if stats is None else stats.setdefault("overflow_flags", [])
     out = None
     first = None
     for i, seeds in enumerate(seed_batches):
@@ -203,10 +217,14 @@ def run_pipelined_epoch(step, sample_first, seed_batches, state,
             out = sample_first(seeds, k)
             first = seeds
             continue
+        if flags is not None and out.metadata:
+            flags.append(out.metadata.get("overflow"))
         state, loss, acc, out = step(state, out, seeds, k)
         losses.append(loss)
         accs.append(acc)
     if out is not None:
+        if flags is not None and out.metadata:
+            flags.append(out.metadata.get("overflow"))
         state, loss, acc, _ = step(state, out, first,
                                    jax.random.fold_in(base_key, 2**31 - 1))
         losses.append(loss)

@@ -261,17 +261,42 @@ class HostColdStore:
             :func:`route_cold_requests` (-1 = not a cold row of ours).
         Returns ``[R, d]`` with zeros at -1 slots.
         """
+        cold_req = np.asarray(cold_req)
+        out = np.zeros((cold_req.shape[0], self.dim), self.dtype)
+        self.serve_into(out, shard, cold_req)
+        return out
+
+    def serve_into(self, out: np.ndarray, shard: int, cold_req: np.ndarray,
+                   pool=None, row_chunk: int = 16384) -> list:
+        """Gather one shard's cold rows into ``out`` (``[R, d]``), row-chunk
+        parallel.
+
+        With ``pool`` (a ThreadPoolExecutor) the gather splits into
+        ``row_chunk``-row work items and returns their futures (caller
+        awaits); numpy fancy indexing releases the GIL during the copy,
+        so chunks scale across host cores — the thread-level rebuild of
+        the warp-parallel UVA gather (unified_tensor.cu:48-81).  Without
+        a pool the gather runs inline and returns ``[]``.
+        """
         if shard not in self._blocks:
             raise KeyError(
                 f"shard {shard} is not local to this host "
                 f"(local: {self.shard_ids})")
         blk = self._blocks[shard]
         cold_req = np.asarray(cold_req)
-        out = np.zeros((cold_req.shape[0], self.dim), self.dtype)
-        sel = cold_req >= 0
-        if blk.shape[0] > 0 and sel.any():
-            out[sel] = blk[cold_req[sel]]
-        return out
+        sel = np.where(cold_req >= 0)[0]
+        if blk.shape[0] == 0 or sel.size == 0:
+            return []
+
+        def work(lo, hi):
+            idx = sel[lo:hi]
+            out[idx] = blk[cold_req[idx]]
+
+        if pool is None:
+            work(0, sel.size)
+            return []
+        return [pool.submit(work, lo, min(lo + row_chunk, sel.size))
+                for lo in range(0, sel.size, row_chunk)]
 
 
 def cold_mask(ids: jnp.ndarray, nodes_per_shard: int,

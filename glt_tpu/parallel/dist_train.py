@@ -196,9 +196,11 @@ def make_tiered_train_step(
     return train
 
 
-class TieredTrainPipeline:
-    """Two-stage software pipeline: jitted sample → host cold gather →
-    jitted train, double-buffered.
+class _ColdStagePipeline:
+    """Shared core of the two-stage (sample → host cold gather → train)
+    pipelines: staging/gather thread pools, the locked drop-counter
+    reduction, the double-buffered epoch loop, and shutdown.  Subclasses
+    implement ``_stage_cold_async(out) -> Future[staged]``.
 
     The cold gather for batch ``k`` runs on a staging thread while the main
     thread trains batch ``k-1`` — steady-state step time ≈
@@ -209,13 +211,123 @@ class TieredTrainPipeline:
     backend, including the synchronous CPU emulation the tests run on.
     """
 
+    def _init_pools(self, stage_threads: Optional[int],
+                    name: str) -> None:
+        import concurrent.futures
+        import os
+        import threading
+
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"{name}-stage")
+        # Gather workers: the host cold gather splits into (shard,
+        # row-chunk) work items fanned across this pool (VERDICT r4 #5 —
+        # the serial per-process stage dominated papers100M-shape steady
+        # state).  numpy fancy indexing releases the GIL, so chunks scale
+        # with host cores; a pod host sizes this to its core count.
+        self.stage_threads = (max(1, os.cpu_count() or 1)
+                              if stage_threads is None
+                              else max(1, int(stage_threads)))
+        self._gather_pool = (concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.stage_threads,
+            thread_name_prefix=f"{name}-gather")
+            if self.stage_threads > 1 else None)
+        self._pending_dropped = []   # unreduced per-batch device counts
+        self.dropped_total = 0       # host sum over all staged batches
+        self._drop_lock = threading.Lock()  # staging thread vs caller
+
+    def _record_dropped(self, dropped) -> None:
+        # Accumulate lazily (device values; reduced on flush) so the
+        # documented contract — "raise cold_cap if drops are ever
+        # nonzero" — is checkable over a whole epoch without a per-batch
+        # host sync.
+        with self._drop_lock:
+            self._pending_dropped.append(dropped)
+
+    def _maybe_flush_on_stage_thread(self) -> None:
+        # Periodic reduction rides the staging thread (it already blocks
+        # on the route stage), never the main thread's critical path
+        # (advisor r4 finding).
+        if len(self._pending_dropped) >= 64:
+            self.flush_dropped()
+
+    def flush_dropped(self) -> int:
+        """Reduce pending per-batch drop counters into ``dropped_total``."""
+        with self._drop_lock:
+            pending, self._pending_dropped = self._pending_dropped, []
+        total = 0
+        for d in pending:
+            for leaf in jax.tree_util.tree_leaves(d):
+                shards = getattr(leaf, "addressable_shards", None)
+                if shards is not None:
+                    total += int(sum(np.asarray(sh.data).sum()
+                                     for sh in shards))
+                else:
+                    total += int(np.asarray(leaf).sum())
+        with self._drop_lock:
+            self.dropped_total += total
+        return self.dropped_total
+
+    def run_epoch(self, state: TrainState, seed_batches, key: jax.Array):
+        """Drive one epoch; ``seed_batches``: iterable of ``[S, B]`` seeds.
+
+        Returns ``(state, losses, accs)`` (device scalars, unsynced).
+        Check ``flush_dropped()`` after the epoch: nonzero means some
+        cold requests overflowed the staging capacity and trained on
+        zero rows.
+        """
+        from . import multihost
+
+        losses, accs = [], []
+        pending = None  # (out, cold future)
+        n = 0
+        for i, seeds in enumerate(seed_batches):
+            kb = jax.random.fold_in(key, i)
+            if not isinstance(seeds, jax.Array):
+                # Per-host feed: every process holds the full [S, B] host
+                # batch (deterministic split) and contributes its rows.
+                seeds = multihost.feed_seeds(np.asarray(seeds), self.mesh,
+                                             self.axis_name)
+            out = self.sampler.sample_from_nodes(
+                seeds, key=jax.random.fold_in(kb, 1))
+            fut = self._stage_cold_async(out)
+            if pending is not None:
+                state, loss, acc = self.train_step(
+                    state, pending[0], pending[1].result(),
+                    jax.random.fold_in(kb, 2))
+                losses.append(loss)
+                accs.append(acc)
+            pending = (out, fut)
+            n = i + 1
+        if pending is not None:
+            state, loss, acc = self.train_step(
+                state, pending[0], pending[1].result(),
+                jax.random.fold_in(jax.random.fold_in(key, n), 2))
+            losses.append(loss)
+            accs.append(acc)
+        return state, losses, accs
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+        if self._gather_pool is not None:
+            self._gather_pool.shutdown(wait=False)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class TieredTrainPipeline(_ColdStagePipeline):
+    """Homogeneous two-stage pipeline (see :class:`_ColdStagePipeline`):
+    jitted sample → host cold gather → jitted train, double-buffered."""
+
     def __init__(self, sampler: DistNeighborSampler,
                  train_step, f: TieredShardedFeature, mesh: Mesh,
                  axis_name: str = "shard",
                  cold_store: Optional[HostColdStore] = None,
-                 cold_cap: Optional[int] = None):
-        import concurrent.futures
-
+                 cold_cap: Optional[int] = None,
+                 stage_threads: Optional[int] = None):
         from . import multihost
         from .dist_feature import compact_cold_requests
 
@@ -237,11 +349,8 @@ class TieredTrainPipeline:
         self._local = multihost.local_shard_range(mesh, axis_name)
         self.cold_store = cold_store or HostColdStore(
             f, shard_ids=self._local)
-        self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="glt-cold-stage")
+        self._init_pools(stage_threads, "glt-cold")
         self.last_dropped = None     # [S] device counts, latest batch
-        self._pending_dropped = []   # unreduced per-batch device counts
-        self.dropped_total = 0       # host sum over all staged batches
         gspec = P(axis_name)
 
         def route_body(nodes):
@@ -269,13 +378,7 @@ class TieredTrainPipeline:
 
         slots, ids, dropped = self._route(out.node)
         self.last_dropped = dropped
-        # Accumulate lazily (device scalars; reduced on flush) so the
-        # documented contract — "raise cold_cap if drops are ever
-        # nonzero" — is checkable over a whole epoch, not just the last
-        # batch, without a per-batch host sync.
-        self._pending_dropped.append(dropped)
-        if len(self._pending_dropped) >= 64:
-            self.flush_dropped()
+        self._record_dropped(dropped)
 
         def work():
             # Fetch only this host's addressable id rows (waits on the
@@ -286,73 +389,18 @@ class TieredTrainPipeline:
             staged = np.zeros(
                 (len(self._local), self.cold_cap, self.cold_store.dim),
                 self.cold_store.dtype)
+            # Fan the gather across (shard, row-chunk) work items.
+            futs = []
             for j, s in enumerate(self._local):
-                staged[j] = self.cold_store.serve(s, req[j])
+                futs += self.cold_store.serve_into(
+                    staged[j], s, req[j], pool=self._gather_pool)
+            for fu in futs:
+                fu.result()
+            self._maybe_flush_on_stage_thread()
             rows = multihost.assemble_global(staged, self.mesh,
                                              self.axis_name)
             return rows, slots
         return self._pool.submit(work)
-
-    def flush_dropped(self) -> int:
-        """Reduce pending per-batch drop counters into ``dropped_total``."""
-        import numpy as np
-
-        for d in self._pending_dropped:
-            shards = getattr(d, "addressable_shards", None)
-            if shards is not None:
-                self.dropped_total += int(sum(
-                    np.asarray(sh.data).sum() for sh in shards))
-            else:
-                self.dropped_total += int(np.asarray(d).sum())
-        self._pending_dropped.clear()
-        return self.dropped_total
-
-    def run_epoch(self, state: TrainState, seed_batches, key: jax.Array):
-        """Drive one epoch; ``seed_batches``: iterable of ``[S, B]`` seeds.
-
-        Returns ``(state, losses, accs)`` (device scalars, unsynced).
-        Check ``flush_dropped()`` after the epoch: nonzero means some
-        cold requests overflowed ``cold_cap`` and trained on zero rows.
-        """
-        from . import multihost
-
-        losses, accs = [], []
-        pending = None  # (out, cold future)
-        n = 0
-        for i, seeds in enumerate(seed_batches):
-            kb = jax.random.fold_in(key, i)
-            if not isinstance(seeds, jax.Array):
-                # Per-host feed: every process holds the full [S, B] host
-                # batch (deterministic split) and contributes its rows.
-                seeds = multihost.feed_seeds(np.asarray(seeds), self.mesh,
-                                             self.axis_name)
-            out = self.sampler.sample_from_nodes(seeds,
-                                                 key=jax.random.fold_in(kb, 1))
-            fut = self._stage_cold_async(out)
-            if pending is not None:
-                state, loss, acc = self.train_step(
-                    state, pending[0], pending[1].result(),
-                    jax.random.fold_in(kb, 2))
-                losses.append(loss)
-                accs.append(acc)
-            pending = (out, fut)
-            n = i + 1
-        if pending is not None:
-            state, loss, acc = self.train_step(
-                state, pending[0], pending[1].result(),
-                jax.random.fold_in(jax.random.fold_in(key, n), 2))
-            losses.append(loss)
-            accs.append(acc)
-        return state, losses, accs
-
-    def close(self) -> None:
-        self._pool.shutdown(wait=False)
-
-    def __del__(self):
-        try:
-            self.close()
-        except Exception:
-            pass
 
 
 def init_dist_state(model, tx, g: ShardedGraph, f,
@@ -463,13 +511,206 @@ def make_hetero_dist_train_step(
     return step
 
 
+def make_hetero_tiered_train_step(
+    model,
+    tx,
+    sampler,                      # DistHeteroNeighborSampler
+    feats,                        # Dict[NodeType, Sharded|TieredSharded]
+    labels: jnp.ndarray,          # [S, c_target] target-type labels
+    mesh: Mesh,
+    batch_size: int,
+    axis_name: str = "shard",
+):
+    """Hetero analog of :func:`make_tiered_train_step` (VERDICT r4 #4):
+    node types whose feature is a :class:`TieredShardedFeature` (e.g.
+    IGBH paper features, ~350 GB — far past a v5e-16's HBM) gather their
+    hot prefix in-jit and take cold rows from compact host staging;
+    full-HBM types use the plain exchange.  Sampling happens OUTSIDE
+    (two-stage pipeline: see :class:`HeteroTieredTrainPipeline`), exactly
+    like the homo tiered step.
+
+    Returns ``train(state, out, staged, key)`` with ``staged`` a dict
+    ``{node_type: (rows [S, cold_cap, d], slots [S, cold_cap])}`` for the
+    tiered types only.
+    """
+    gspec = P(axis_name)
+    tgt = sampler.input_type
+    tiered = sorted(t for t, f in feats.items()
+                    if isinstance(f, TieredShardedFeature))
+    hot_rows = {t: (f.hot if isinstance(f, TieredShardedFeature)
+                    else f.rows) for t, f in feats.items()}
+    meta = {t: (f.nodes_per_shard,
+                (f.hot_per_shard if isinstance(f, TieredShardedFeature)
+                 else f.nodes_per_shard),
+                f.num_shards) for t, f in feats.items()}
+    label_c = int(labels.shape[1])
+    num_shards = next(iter(sampler.sharded.values())).num_shards
+
+    def local_body(hot_blk, labels_blk, out, srows_blk, sslots_blk, params,
+                   key):
+        hot_l = {t: r[0] for t, r in hot_blk.items()}
+        labels_l = labels_blk[0]
+        srows = {t: r[0] for t, r in srows_blk.items()}
+        sslots = {t: r[0] for t, r in sslots_blk.items()}
+        out = jax.tree.map(lambda x: x[0], out)
+        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+
+        x = {}
+        for t in hot_l:
+            c, h, s = meta[t]
+            if t in srows:
+                x[t] = exchange_gather_hot(out.node[t], hot_l[t], c, h, s,
+                                           axis_name,
+                                           staged_rows=srows[t],
+                                           staged_slots=sslots[t])
+            else:
+                x[t] = exchange_gather(out.node[t], hot_l[t], c, s,
+                                       axis_name)
+        y = exchange_gather(out.node[tgt],
+                            labels_l[:, None].astype(jnp.int32),
+                            label_c, num_shards, axis_name)[:, 0]
+        y = jnp.where(out.node[tgt] >= 0, y, PADDING_ID)
+        edge_index = {et: jnp.stack([out.row[et], out.col[et]])
+                      for et in out.row}
+
+        def loss_fn(prm):
+            logits = model.apply(prm, x, edge_index, out.edge_mask,
+                                 train=True, rngs={"dropout": key})
+            return seed_cross_entropy(logits, y, batch_size,
+                                      out.node_mask[tgt])
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params)
+        grads = lax.pmean(grads, axis_name)
+        loss = lax.pmean(loss, axis_name)
+        acc = lax.pmean(acc, axis_name)
+        return loss, acc, grads
+
+    hot_specs = {t: gspec for t in hot_rows}
+    st_specs = {t: gspec for t in tiered}
+    shard_fn = jax.shard_map(
+        local_body, mesh=mesh,
+        in_specs=(hot_specs, gspec, gspec, st_specs, st_specs, P(), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False)
+
+    @jax.jit
+    def _train(hot_arg, labels_blk, state: TrainState, out, srows, sslots,
+               key: jax.Array):
+        loss, acc, grads = shard_fn(hot_arg, labels_blk, out, srows,
+                                    sslots, state.params, key)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss, acc
+
+    def train(state: TrainState, out, staged, key: jax.Array):
+        srows = {t: staged[t][0] for t in tiered}
+        sslots = {t: staged[t][1] for t in tiered}
+        return _train(hot_rows, labels, state, out, srows, sslots, key)
+
+    return train
+
+
+class HeteroTieredTrainPipeline(_ColdStagePipeline):
+    """Hetero two-stage pipeline: jitted hetero sample → per-type host
+    cold gather → jitted hetero train, double-buffered.
+
+    The hetero twin of :class:`TieredTrainPipeline` (VERDICT r4 #4): each
+    tiered node type routes + compacts its own cold requests (one jitted
+    shard_map over the dict), the host gathers each type's compact id
+    list (row-chunk parallel across ``stage_threads``), and the train
+    step scatters every type's staged rows into its gather response.
+    """
+
+    def __init__(self, sampler, train_step, feats, mesh: Mesh,
+                 axis_name: str = "shard",
+                 cold_caps=None,
+                 stage_threads: Optional[int] = None):
+        from . import multihost
+        from .dist_feature import compact_cold_requests
+
+        self.sampler = sampler
+        self.train_step = train_step
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.tiered = {t: f for t, f in feats.items()
+                       if isinstance(f, TieredShardedFeature)}
+        cap_by_type = sampler.node_capacity
+        self.cold_cap = {
+            t: (2 * max(cap_by_type.get(t, 1), 1)
+                if not cold_caps or t not in cold_caps else int(cold_caps[t]))
+            for t in self.tiered}
+        self._local = multihost.local_shard_range(mesh, axis_name)
+        self.stores = {t: HostColdStore(f, shard_ids=self._local)
+                       for t, f in self.tiered.items()}
+        self._init_pools(stage_threads, "glt-hcold")
+        gspec = P(axis_name)
+        tiered_types = sorted(self.tiered)
+
+        def route_body(nodes_blk):
+            slots, ids, dropped = {}, {}, {}
+            for t in tiered_types:
+                f = self.tiered[t]
+                req = route_cold_requests(
+                    nodes_blk[t][0], f.nodes_per_shard, f.hot_per_shard,
+                    f.num_shards, axis_name)
+                s, i, d = compact_cold_requests(req, self.cold_cap[t])
+                slots[t], ids[t], dropped[t] = s[None], i[None], d[None]
+            return slots, ids, dropped
+
+        tspec = {t: gspec for t in tiered_types}
+        self._route = jax.jit(jax.shard_map(
+            route_body, mesh=mesh, in_specs=({t: gspec for t in tiered_types},),
+            out_specs=(tspec, tspec, tspec), check_vma=False))
+
+    def _stage_cold_async(self, out):
+        from . import multihost
+
+        nodes = {t: out.node[t] for t in self.tiered}
+        slots, ids, dropped = self._route(nodes)
+        self._record_dropped(dropped)
+
+        def work():
+            staged = {}
+            futs = []
+            arrs = {}
+            for t in sorted(self.tiered):
+                shards = sorted(ids[t].addressable_shards,
+                                key=lambda sh: sh.index[0].start or 0)
+                req = np.concatenate([np.asarray(sh.data)
+                                      for sh in shards])
+                st = self.stores[t]
+                arr = np.zeros((len(self._local), self.cold_cap[t],
+                                st.dim), st.dtype)
+                for j, s in enumerate(self._local):
+                    futs += st.serve_into(arr[j], s, req[j],
+                                          pool=self._gather_pool)
+                arrs[t] = arr
+            for fu in futs:
+                fu.result()
+            self._maybe_flush_on_stage_thread()
+            for t, arr in arrs.items():
+                rows = multihost.assemble_global(arr, self.mesh,
+                                                 self.axis_name)
+                staged[t] = (rows, slots[t])
+            return staged
+        return self._pool.submit(work)
+
+
 def init_hetero_dist_state(model, tx, sampler, feats,
                            rng: jax.Array) -> TrainState:
-    """Replicated params/opt-state from the sampler's static shapes."""
+    """Replicated params/opt-state from the sampler's static shapes.
+
+    ``feats`` values may be :class:`ShardedFeature` or
+    :class:`TieredShardedFeature`."""
     capacity = sampler.node_capacity
     widths = sampler.hop_widths
+
+    def _rows(f):
+        return f.hot if isinstance(f, TieredShardedFeature) else f.rows
+
     x = {t: jnp.zeros((max(capacity[t], 1),
-                       feats[t].rows.shape[-1]), feats[t].rows.dtype)
+                       _rows(feats[t]).shape[-1]), _rows(feats[t]).dtype)
          for t in feats}
     ei, mask = {}, {}
     from ..typing import reverse_edge_type

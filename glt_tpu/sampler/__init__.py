@@ -7,9 +7,15 @@ from .base import (
     SamplerOutput,
     SamplingConfig,
 )
-from .neighbor_sampler import NeighborSampler
+from .neighbor_sampler import (
+    NeighborSampler,
+    calibrate_node_capacity,
+    measure_occupancy,
+)
 
 __all__ = [
+    "calibrate_node_capacity",
+    "measure_occupancy",
     "BaseSampler",
     "EdgeSamplerInput",
     "HeteroSamplerOutput",

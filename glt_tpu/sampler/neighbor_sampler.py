@@ -86,6 +86,57 @@ def max_sampled_nodes(batch_size: int, fanouts: Sequence[int],
     return widths[0] + sum(w * f for w, f in zip(widths, fanouts))
 
 
+def measure_occupancy(sampler: "NeighborSampler", seed_batches) -> np.ndarray:
+    """Unique-node counts per seed batch (ONE host fetch for all batches).
+
+    The sampler's padded node buffer is sized to the zero-dedup worst case
+    (the reference's ``_max_sampled_nodes``, neighbor_sampler.py:595-612);
+    on real graphs per-batch occupancy is far lower.  This measures the
+    actual interior-unique count per batch so callers can size the static
+    capacity to a percentile instead of the worst case — feature-gather
+    cost (~121 ns per padded row on v5e), the train step's segment ops,
+    and HBM footprint all scale with the padded width.
+
+    In leaf-block mode (``last_hop_dedup=False``) the final hop's width is
+    static, so only interior hops are counted.
+    """
+    import jax as _jax
+
+    counts = []
+    for seeds in seed_batches:
+        out = sampler.sample_from_nodes(NodeSamplerInput(seeds))
+        n = out.num_sampled_nodes
+        if not sampler.last_hop_dedup:
+            n = n[:-1]
+        counts.append(jnp.sum(n))
+    return np.asarray(_jax.device_get(jnp.stack(counts)))
+
+
+def calibrate_node_capacity(sampler: "NeighborSampler", seed_batches=None,
+                            pct: float = 99.0, margin: float = 1.05,
+                            multiple: int = 256,
+                            counts: Optional[np.ndarray] = None) -> int:
+    """Occupancy-sized static node capacity for a calibrated workload.
+
+    Samples ``seed_batches`` through ``sampler`` (typically uncapped),
+    takes the ``pct`` percentile of interior-unique counts, applies a
+    safety ``margin``, rounds up to ``multiple`` rows (sublane/lane tile
+    alignment), and re-adds the static leaf-block width in leaf mode.
+    Feed the result to ``NeighborSampler(node_capacity=...)``; batches
+    that exceed it are flagged via ``metadata['overflow']`` and their
+    excess-node edges are masked (or exactly re-sampled by the loaders'
+    full-capacity fallback).
+    """
+    if counts is None:
+        counts = measure_occupancy(sampler, seed_batches)
+    interior = float(np.percentile(counts, pct)) * margin
+    leaf_w = (0 if sampler.last_hop_dedup
+              else sampler._widths[-1] * sampler.num_neighbors[-1])
+    cap = int(np.ceil(interior / multiple) * multiple) + leaf_w
+    cap = max(cap, sum(sampler._widths) + leaf_w)
+    return min(cap, sampler.full_node_capacity)
+
+
 class NeighborSampler(BaseSampler):
     """Fixed-fanout multi-hop sampler over a :class:`~glt_tpu.data.graph.Graph`.
 
@@ -127,6 +178,7 @@ class NeighborSampler(BaseSampler):
         seed: int = 0,
         dedup: str = "auto",
         last_hop_dedup: bool = True,
+        node_capacity: Optional[int] = None,
     ):
         self.graph = graph
         self.num_neighbors = list(num_neighbors)
@@ -145,8 +197,27 @@ class NeighborSampler(BaseSampler):
 
         self._widths = hop_widths(self.batch_size, self.num_neighbors,
                                   frontier_cap)
-        self.node_capacity = max_sampled_nodes(self.batch_size,
-                                               self.num_neighbors, frontier_cap)
+        self.full_node_capacity = max_sampled_nodes(
+            self.batch_size, self.num_neighbors, frontier_cap)
+        if node_capacity is None:
+            # Zero-dedup worst case — the reference's sizing
+            # (_max_sampled_nodes, neighbor_sampler.py:595-612).
+            self.node_capacity = self.full_node_capacity
+        else:
+            # Occupancy-sized cap (see calibrate_node_capacity): the
+            # buffer holds only the first `node_capacity` uniques; later
+            # discoveries overflow — their edges are masked and the batch
+            # is flagged via metadata['overflow'].
+            nc = int(node_capacity)
+            leaf_w = (0 if self.last_hop_dedup
+                      else self._widths[-1] * self.num_neighbors[-1])
+            floor_cap = sum(self._widths) + leaf_w
+            if nc < floor_cap:
+                raise ValueError(
+                    f"node_capacity {nc} below the frontier floor "
+                    f"{floor_cap} (sum of hop widths + leaf block)")
+            self.node_capacity = min(nc, self.full_node_capacity)
+        self.capped = self.node_capacity < self.full_node_capacity
         self.edge_capacity = sum(
             w * f for w, f in zip(self._widths, self.num_neighbors))
 
@@ -154,6 +225,21 @@ class NeighborSampler(BaseSampler):
         self._sample_many_jit = {}
         self._sample_edges_jit = {}
         self._subgraph_jit = {}
+        self._full_sibling: Optional["NeighborSampler"] = None
+
+    def full_capacity_sibling(self) -> "NeighborSampler":
+        """Uncapped twin (same graph/fanouts) for exact re-sampling of
+        overflow-flagged batches (its program compiles lazily on the
+        first overflow; shapes differ, so consumers see a second
+        compiled bucket)."""
+        if not self.capped:
+            return self
+        if self._full_sibling is None:
+            self._full_sibling = NeighborSampler(
+                self.graph, self.num_neighbors, self.batch_size,
+                frontier_cap=self.frontier_cap, with_edge=self.with_edge,
+                dedup=self.dedup, last_hop_dedup=self.last_hop_dedup)
+        return self._full_sibling
 
     # -- key management ----------------------------------------------------
     def _next_key(self) -> jax.Array:
@@ -198,6 +284,13 @@ class NeighborSampler(BaseSampler):
         # Static interior capacity: where the no-dedup leaf block starts.
         leaf_off = cap - widths[-1] * fanouts[-1]
         leaf_mask = None
+        capped = self.capped
+        # Largest valid interior local index + 1: under an occupancy-sized
+        # cap, nodes assigned locals past this are overflow — their edges
+        # are masked and the batch flagged (the uncapped program compiles
+        # byte-identically: every `capped` branch below is trace-time
+        # static and off).
+        interior_cap = cap if self.last_hop_dedup else leaf_off
 
         for i, f in enumerate(fanouts):
             w = widths[i]
@@ -208,6 +301,13 @@ class NeighborSampler(BaseSampler):
             # Seed-side local indices (position of frontier nodes in node_buf).
             src_local = frontier_start + jnp.arange(w, dtype=jnp.int32)
             src_local = jnp.where(frontier >= 0, src_local, PADDING_ID)
+            emask = out.mask
+            if capped:
+                # Frontier slots past the cap hold garbage on overflow
+                # batches; mask every edge they source.
+                src_local = jnp.where(src_local < interior_cap, src_local,
+                                      PADDING_ID)
+                emask = emask & (src_local >= 0)[:, None]
 
             # Insert this hop's neighbors into the cumulative unique list;
             # old uniques keep their positions.
@@ -216,7 +316,7 @@ class NeighborSampler(BaseSampler):
                 # Leaf block: no inducer at the widest frontier.  Local
                 # ids are static offsets; the only memory traffic is one
                 # CONTIGUOUS store of the candidates themselves.
-                leaf_mask = out.mask.ravel()
+                leaf_mask = emask.ravel()
                 leaf_ids = jnp.where(leaf_mask, cand, PADDING_ID)
                 nbr_local = (leaf_off
                              + jnp.arange(w * f, dtype=jnp.int32)
@@ -224,6 +324,13 @@ class NeighborSampler(BaseSampler):
                 if dense:
                     node_buf = jax.lax.dynamic_update_slice(
                         node_buf, leaf_ids, (leaf_off,))
+                elif capped:
+                    # The growing sort-path buffer has full-width interior
+                    # length L >= leaf_off; truncate to leaf_off so the
+                    # leaf block lands exactly where nbr_local points
+                    # (interior locals >= leaf_off are already masked).
+                    node_buf = jnp.concatenate([node_buf[:leaf_off],
+                                                leaf_ids])
                 else:
                     node_buf = jnp.concatenate([node_buf, leaf_ids])
                 new_count = count + jnp.sum(leaf_mask.astype(jnp.int32))
@@ -240,14 +347,22 @@ class NeighborSampler(BaseSampler):
                 node_buf = merged.uniques              # [buflen + w*f]
                 new_count = merged.count
                 nbr_local = merged.inverse[buflen:].reshape(w, f)
-            nbr_local = jnp.where(out.mask, nbr_local, PADDING_ID)
+            nbr_local = jnp.where(emask, nbr_local, PADDING_ID)
+            if capped and not (last and not self.last_hop_dedup):
+                # Induced locals past the cap point at dropped nodes
+                # (dense_induce dump-slot clamp / sort-path truncation):
+                # mask those edges out.
+                lim = cap if self.last_hop_dedup else interior_cap
+                nbr_local = jnp.where(nbr_local < lim, nbr_local,
+                                      PADDING_ID)
+                emask = emask & (nbr_local >= 0)
 
             rows.append(nbr_local.ravel())
             cols.append(jnp.broadcast_to(src_local[:, None], (w, f)).ravel())
             if self.with_edge:
                 eids.append(out.eids.ravel())
-            emasks.append(out.mask.ravel())
-            edges_per_hop.append(jnp.sum(out.mask.astype(jnp.int32)))
+            emasks.append(emask.ravel())
+            edges_per_hop.append(jnp.sum(emask.astype(jnp.int32)))
 
             if not last:
                 nw = widths[i + 1]
@@ -281,6 +396,21 @@ class NeighborSampler(BaseSampler):
             [counts_per_hop[0]]
             + [counts_per_hop[i + 1] - counts_per_hop[i]
                for i in range(len(fanouts))])
+        metadata = None
+        if capped:
+            # `count` keeps counting uniques past the cap (dense_induce's
+            # dump slot absorbs their writes), so overflow is exactly
+            # "more uniques discovered than the buffer holds".  Loaders
+            # check this flag to fall back to the exact full-capacity
+            # program; the flagged batch itself is still safe to train on
+            # (overflow-node edges are masked above).
+            # counts_per_hop holds the UNCLAMPED totals (`count` itself is
+            # min'd to cap just above for the node_mask).
+            if self.last_hop_dedup:
+                overflow = counts_per_hop[-1] > cap
+            else:
+                overflow = counts_per_hop[len(fanouts) - 1] > leaf_off
+            metadata = {"overflow": overflow}
         return SamplerOutput(
             node=node_buf,
             # Direction transpose: row = neighbor side, col = seed side
@@ -293,6 +423,7 @@ class NeighborSampler(BaseSampler):
             edge_mask=jnp.concatenate(emasks),
             num_sampled_nodes=num_sampled_nodes,
             num_sampled_edges=jnp.stack(edges_per_hop),
+            metadata=metadata,
         )
 
     # -- public API (cf. sampler/neighbor_sampler.py:138) ------------------
@@ -457,13 +588,18 @@ class NeighborSampler(BaseSampler):
             sub.node_capacity = max_sampled_nodes(seed_width,
                                                   self.num_neighbors,
                                                   self.frontier_cap)
+            # The seed union runs at its own width's full capacity; an
+            # occupancy cap on the node path does not transfer (different
+            # batch width => different occupancy distribution).
+            sub.full_node_capacity = sub.node_capacity
+            sub.capped = False
             out = sub._sample_impl(indptr, indices, edge_ids, seed_ids,
                                    ksample)
         else:
             out = self._sample_impl(indptr, indices, edge_ids, seed_ids,
                                     ksample)
 
-        meta = {}
+        meta = dict(out.metadata or {})
         # Seed ids all first-occur within the hop-0 prefix of the node
         # list, so relabel against that slice only — with
         # last_hop_dedup=False the tail leaf block may hold duplicate
@@ -578,5 +714,6 @@ class NeighborSampler(BaseSampler):
             node_mask=base.node_mask,
             edge_mask=sub.mask,
             num_sampled_nodes=base.num_sampled_nodes,
-            metadata={"mapping": jnp.arange(self.batch_size, dtype=jnp.int32)},
+            metadata={"mapping": jnp.arange(self.batch_size, dtype=jnp.int32),
+                      **(base.metadata or {})},
         )

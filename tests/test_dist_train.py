@@ -140,3 +140,138 @@ def test_hetero_dist_train_loss_drops():
                                 jax.random.PRNGKey(100 + it))
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def _bipartite_fixture():
+    """Shared bipartite user/item fixture (see hetero test above)."""
+    rng = np.random.default_rng(0)
+    U, I, classes = 64, 32, 4
+    labels = (np.arange(U) % classes).astype(np.int32)
+    u_src = np.repeat(np.arange(U), 3)
+    i_dst = np.concatenate([
+        [(u % classes) + classes * ((u // classes + k) % (I // classes))
+         for k in range(3)] for u in range(U)])
+    ET_UI = ("user", "clicks", "item")
+    ET_IU = ("item", "rev_clicks", "user")
+    topos = {
+        ET_UI: CSRTopo(np.stack([u_src, i_dst]), num_nodes=U),
+        ET_IU: CSRTopo(np.stack([i_dst, u_src]), num_nodes=I),
+    }
+    item_feat = np.eye(classes, dtype=np.float32)[np.arange(I) % classes]
+    item_feat = np.concatenate(
+        [item_feat, rng.normal(0, .1, (I, 12)).astype(np.float32)], 1)
+    user_feat = rng.normal(0, .1, (U, 16)).astype(np.float32)
+    return (U, I, classes, labels, topos, user_feat, item_feat,
+            ET_UI, ET_IU)
+
+
+def test_hetero_tiered_train_matches_full():
+    """Hetero tiered gather parity (VERDICT r4 #4): the staged-cold train
+    step produces EXACTLY the loss of the full-HBM step on the same
+    sampled batch, params, and key."""
+    from glt_tpu.models.rgat import RGAT
+    from glt_tpu.parallel import (
+        DistHeteroNeighborSampler,
+        HeteroTieredTrainPipeline,
+        init_hetero_dist_state,
+        make_hetero_tiered_train_step,
+        shard_feature,
+        shard_feature_tiered,
+        shard_hetero_graph,
+    )
+
+    (U, I, classes, labels, topos, user_feat, item_feat,
+     ET_UI, ET_IU) = _bipartite_fixture()
+    devs = jax.devices()[:N_DEV]
+    mesh = Mesh(np.array(devs), ("shard",))
+    sharded = shard_hetero_graph(topos, N_DEV)
+    lab = jnp.asarray(labels.reshape(N_DEV, -1))
+    bs = 4
+    samp = DistHeteroNeighborSampler(sharded, mesh, [3, 3], "user",
+                                     batch_size=bs, frontier_cap=32,
+                                     seed=0)
+    model = RGAT(edge_types=[ET_IU, ET_UI], hidden_features=16,
+                 out_features=classes, target_type="user", num_layers=2,
+                 conv="gat", dropout_rate=0.0)
+    tx = optax.adam(1e-2)
+
+    feats_full = {"user": shard_feature(user_feat, N_DEV),
+                  "item": shard_feature(item_feat, N_DEV)}
+    feats_tier = {"user": shard_feature(user_feat, N_DEV),
+                  "item": shard_feature_tiered(item_feat, N_DEV,
+                                               hot_ratio=0.25)}
+    state = init_hetero_dist_state(model, tx, samp, feats_tier,
+                                   jax.random.PRNGKey(0))
+
+    train_full = make_hetero_tiered_train_step(
+        model, tx, samp, feats_full, lab, mesh, batch_size=bs)
+    train_tier = make_hetero_tiered_train_step(
+        model, tx, samp, feats_tier, lab, mesh, batch_size=bs)
+    pipe = HeteroTieredTrainPipeline(samp, train_tier, feats_tier, mesh)
+
+    seeds = np.stack([
+        np.random.default_rng(s).choice(np.arange(s * 8, (s + 1) * 8), bs,
+                                        replace=False)
+        for s in range(N_DEV)]).astype(np.int32)
+    out = samp.sample_from_nodes(jnp.asarray(seeds))
+    staged = pipe._stage_cold_async(out).result()
+    k = jax.random.PRNGKey(3)
+    _, loss_t, acc_t = train_tier(state, out, staged, k)
+    _, loss_f, acc_f = train_full(state, out, {}, k)
+    np.testing.assert_allclose(float(loss_t), float(loss_f), rtol=1e-6)
+    np.testing.assert_allclose(float(acc_t), float(acc_f), rtol=1e-6)
+    assert pipe.flush_dropped() == 0
+    pipe.close()
+
+
+def test_hetero_tiered_pipeline_loss_drops():
+    """End-to-end hetero two-stage pipeline: sample -> per-type host cold
+    staging (row-chunk parallel) -> train; loss must drop, no drops."""
+    from glt_tpu.models.rgat import RGAT
+    from glt_tpu.parallel import (
+        DistHeteroNeighborSampler,
+        HeteroTieredTrainPipeline,
+        init_hetero_dist_state,
+        make_hetero_tiered_train_step,
+        shard_feature,
+        shard_feature_tiered,
+        shard_hetero_graph,
+    )
+
+    (U, I, classes, labels, topos, user_feat, item_feat,
+     ET_UI, ET_IU) = _bipartite_fixture()
+    devs = jax.devices()[:N_DEV]
+    mesh = Mesh(np.array(devs), ("shard",))
+    sharded = shard_hetero_graph(topos, N_DEV)
+    lab = jnp.asarray(labels.reshape(N_DEV, -1))
+    bs = 4
+    # Bounded exchange + tiered features together — the full hetero
+    # parity configuration (VERDICT r4 #4).
+    samp = DistHeteroNeighborSampler(sharded, mesh, [3, 3], "user",
+                                     batch_size=bs, frontier_cap=32,
+                                     seed=0, exchange_load_factor=8.0)
+    model = RGAT(edge_types=[ET_IU, ET_UI], hidden_features=16,
+                 out_features=classes, target_type="user", num_layers=2,
+                 conv="gat", dropout_rate=0.0)
+    tx = optax.adam(1e-2)
+    feats = {"user": shard_feature(user_feat, N_DEV),
+             "item": shard_feature_tiered(item_feat, N_DEV,
+                                          hot_ratio=0.25)}
+    state = init_hetero_dist_state(model, tx, samp, feats,
+                                   jax.random.PRNGKey(0))
+    train = make_hetero_tiered_train_step(model, tx, samp, feats, lab,
+                                          mesh, batch_size=bs)
+    pipe = HeteroTieredTrainPipeline(samp, train, feats, mesh,
+                                     stage_threads=2)
+    losses = []
+    for epoch in range(10):
+        batches = [np.stack([
+            np.random.default_rng(epoch * 31 + it * N_DEV + s).choice(
+                np.arange(s * 8, (s + 1) * 8), bs, replace=False)
+            for s in range(N_DEV)]).astype(np.int32) for it in range(4)]
+        state, ls, _ = pipe.run_epoch(state, batches,
+                                      jax.random.PRNGKey(epoch))
+        losses += [float(x) for x in ls]
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+    assert pipe.flush_dropped() == 0
+    pipe.close()
