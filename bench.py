@@ -451,6 +451,74 @@ def main():
             overflow_rate = float(np.asarray(
                 jax.device_get(jnp.stack(flags))).mean())
 
+    # --- distributed path on THIS chip (VERDICT r4 #6): the shard_map
+    # sampler + fused dist train step on a 1-device mesh.  The collectives
+    # are degenerate, so the delta vs the single-device path is the
+    # device-side cost of the routing machinery itself (owner bucketing
+    # sorts, request scatters, response unscatters) — the number the
+    # v5e-16 projection in BASELINE.md combines with the CPU-mesh
+    # exchange-byte counters.
+    _progress("dist path on-chip (1-device mesh)")
+    from jax.sharding import Mesh
+
+    from glt_tpu.parallel import (
+        DistNeighborSampler,
+        init_dist_state,
+        make_dist_train_step,
+        shard_feature,
+        shard_graph,
+    )
+
+    from glt_tpu.parallel.sharding import put_sharded
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("shard",))
+    # Pre-place the sharded arrays on the mesh ONCE — passing host/
+    # unsharded arrays makes every jitted call re-transfer the whole
+    # graph + feature (measured: a 5 s/step artifact, not device time).
+    sg = put_sharded(shard_graph(topo, 1), mesh1, "shard")
+    dsampler = DistNeighborSampler(sg, mesh1, num_neighbors=FANOUT,
+                                   batch_size=BATCH, frontier_cap=fcap,
+                                   seed=0, exchange_load_factor=2.0)
+    dseeds = [jnp.asarray(np.asarray(b).reshape(1, BATCH))
+              for b in batches]
+    o = dsampler.sample_from_nodes(dseeds[0])       # warm compile
+    tot = jnp.zeros((), jnp.int32)
+    tot = acc_edges(tot, o.num_sampled_edges)
+    sync(tot)
+    tot = jnp.zeros((), jnp.int32)
+    t0 = time.perf_counter()
+    for i in range(t_iters):
+        o = dsampler.sample_from_nodes(dseeds[(WARMUP + i) % len(dseeds)])
+        tot = acc_edges(tot, o.num_sampled_edges)
+    sync(tot)
+    dist_sample_ms = (time.perf_counter() - t0) / t_iters * 1e3
+
+    sf = put_sharded(shard_feature(np.asarray(feat.hot_rows), 1),
+                     mesh1, "shard")
+    dlabels = jax.device_put(
+        jnp.asarray(np.asarray(labels).reshape(1, -1)),
+        jax.sharding.NamedSharding(mesh1,
+                                   jax.sharding.PartitionSpec("shard")))
+    dstate = init_dist_state(model_f32, tx, sg, sf, jax.random.PRNGKey(0),
+                             FANOUT, BATCH, frontier_cap=fcap)
+    dstep = make_dist_train_step(model_f32, tx, sg, sf, dlabels, mesh1,
+                                 FANOUT, BATCH, frontier_cap=fcap,
+                                 exchange_load_factor=2.0)
+    # Warm TWICE: call 1 takes the fresh (uncommitted) state, call 2 the
+    # mesh-committed output state — a different input sharding, i.e. a
+    # second compile that must not land inside the timed loop.
+    st, l, _ = dstep(dstate, dseeds[0], jax.random.fold_in(base, 300))
+    st, l, _ = dstep(st, dseeds[1], jax.random.fold_in(base, 299))
+    sync(l)
+    t0 = time.perf_counter()
+    for i in range(t_iters):
+        st, l, _ = dstep(st, dseeds[(WARMUP + i) % len(dseeds)],
+                         jax.random.fold_in(base, 301 + i))
+    sync(l)
+    dist_step_ms = (time.perf_counter() - t0) / t_iters * 1e3
+    _PARTIAL.update({"dist_sample_ms_tpu": round(dist_sample_ms, 2),
+                     "dist_step_ms_tpu": round(dist_step_ms, 2)})
+
     # Analytic train FLOPs (fwd 2 matmuls/layer over the padded node cap;
     # bwd ~2x fwd) -> achieved TFLOP/s on the train-only step.
     dims = [dim] + [hidden] * (len(FANOUT) - 1) + [classes]
@@ -515,6 +583,13 @@ def main():
         "sampling_overhead_frac": round(
             best_step_ms / max(capped["train_ms"], 1e-9) - 1.0, 3),
         "subgraphs_per_s": round(1e3 / best_step_ms, 1),
+        # Distributed path on the real chip (1-device mesh: degenerate
+        # collectives, so this isolates the routing machinery's device
+        # cost vs the single-device programs above).
+        "dist_sample_ms_tpu": round(dist_sample_ms, 2),
+        "dist_step_ms_tpu": round(dist_step_ms, 2),
+        "dist_routing_overhead": round(
+            dist_sample_ms / max(full["sample_ms"], 1e-9), 2),
         # MEASURED flagship epoch — same code path as the README headline
         # (examples/train_sage_products.py defaults), not an estimate.
         "epoch_s_config1_measured": round(epoch_s, 2),

@@ -193,13 +193,21 @@ def igbh_from_disk(name: str = "igbh-tiny", graph_mode: str = "HOST"):
 
 
 def synthetic_igbh(scale: float = 1.0, seed: int = 0,
-                   graph_mode: str = "DEVICE"):
+                   graph_mode: str = "DEVICE", use_real: bool = False):
     """IGBH-tiny-shaped hetero graph: paper/author/institute.
 
-    Loads a converted real IGBH from ``DATA_ROOT/igbh-tiny`` when present
-    (scripts/convert_ogb.py)."""
-    real = igbh_from_disk("igbh-tiny", graph_mode="HOST")
-    if real is not None:
+    With ``use_real=True``, loads a converted real IGBH from
+    ``DATA_ROOT/igbh-tiny`` (scripts/convert_ogb.py) — honoring the
+    caller's ``graph_mode`` — and raises if it is absent.  The default
+    always builds the synthetic fixture (``scale``/``seed`` honored), so
+    benchmarks never silently change shape based on ambient disk state.
+    """
+    if use_real:
+        real = igbh_from_disk("igbh-tiny", graph_mode=graph_mode)
+        if real is None:
+            raise FileNotFoundError(
+                f"use_real=True but no converted IGBH under "
+                f"{DATA_ROOT}/igbh-tiny (run scripts/convert_ogb.py)")
         return real
     return _synthetic_citation_hetero(
         {"paper": (200, 1000), "author": (150, 800), "institute": (20, 80)},

@@ -51,7 +51,7 @@ def run_distributed(args):
             f"JAX_PLATFORMS=cpu for the virtual CPU mesh")
     mesh = Mesh(np.array(devices[:n_dev]), ("shard",))
 
-    ds, train_idx, classes = synthetic_igbh(scale=args.scale)
+    ds, train_idx, classes = synthetic_igbh(scale=args.scale, use_real=args.use_real)
     topos = {et: g.topo for et, g in ds.graph.items()}
     sharded = shard_hetero_graph(topos, n_dev)
     feats = {t: shard_feature(np.asarray(ds.node_features[t]._host_full),
@@ -105,6 +105,9 @@ def run_distributed(args):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--use-real", action="store_true",
+                    help="load the converted real IGBH from DATA_ROOT/"
+                         "igbh-tiny instead of the synthetic fixture")
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--distributed", type=int, default=0, metavar="N",
@@ -122,7 +125,7 @@ def main():
     if args.distributed:
         return run_distributed(args)
 
-    ds, train_idx, classes = synthetic_igbh(scale=args.scale)
+    ds, train_idx, classes = synthetic_igbh(scale=args.scale, use_real=args.use_real)
     loader = HeteroNeighborLoader(ds, [4, 4], ("paper", train_idx),
                                   batch_size=args.batch_size, shuffle=True)
 

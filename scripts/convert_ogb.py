@@ -57,7 +57,8 @@ def _sha256(path: str) -> str:
     return h.hexdigest()
 
 
-def _write(out_dir: str, arrays: dict, meta: dict) -> None:
+def _write(out_dir: str, arrays: dict, meta: dict,
+           prewritten: tuple = ()) -> None:
     os.makedirs(out_dir, exist_ok=True)
     checks = {}
     for name, arr in arrays.items():
@@ -65,6 +66,8 @@ def _write(out_dir: str, arrays: dict, meta: dict) -> None:
         np.save(path, arr)
         checks[name + ".npy"] = _sha256(path)
         print(f"  wrote {name}.npy  shape={arr.shape} dtype={arr.dtype}")
+    for name in prewritten:  # streamed straight to disk (e.g. feat.npy)
+        checks[name + ".npy"] = _sha256(os.path.join(out_dir, name + ".npy"))
     meta = dict(meta, checksums=checks)
     with open(os.path.join(out_dir, "META.json"), "w") as fh:
         json.dump(meta, fh, indent=2)
@@ -90,6 +93,46 @@ def _read_csv_gz(path: str, dtype) -> np.ndarray:
     return pd.read_csv(path, header=None).to_numpy(dtype=dtype)
 
 
+def _stream_feat_csv_gz(path: str, n_rows: int, out_npy: str,
+                        chunk_rows: int = 1_000_000) -> tuple:
+    """Stream node-feat.csv.gz into an on-disk ``.npy`` memmap.
+
+    At papers100M scale (111M rows x 128 floats ~ 57 GB) a full pandas
+    read needs well over 100 GB of RAM; chunked parsing into an
+    ``open_memmap`` keeps peak memory at one chunk (~0.5 GB) regardless
+    of dataset size.  Returns ``(rows_written, dim)``.
+    """
+    import pandas as pd
+    from numpy.lib.format import open_memmap
+
+    os.makedirs(os.path.dirname(out_npy), exist_ok=True)
+    out = None
+    lo = 0
+    for chunk in pd.read_csv(path, header=None, chunksize=chunk_rows,
+                             dtype=np.float32):
+        arr = chunk.to_numpy(np.float32)
+        if out is None:
+            out = open_memmap(out_npy, mode="w+", dtype=np.float32,
+                              shape=(n_rows, arr.shape[1]))
+        out[lo: lo + arr.shape[0]] = arr
+        lo += arr.shape[0]
+        print(f"  feat rows {lo}/{n_rows}", end="\r")
+    print()
+    if out is None:
+        raise ValueError(f"{path} is empty")
+    if lo != n_rows:
+        # open_memmap pre-sized the file with zero fill; a truncated
+        # source must fail loudly, not checksum-certify zero-feature
+        # tail rows.
+        raise ValueError(
+            f"{path}: parsed {lo} rows, expected {n_rows} — source "
+            f"truncated or num-node-list mismatch")
+    dim = out.shape[1]
+    out.flush()
+    del out
+    return lo, dim
+
+
 def convert_ogbn(raw: str, split: str, out: str,
                  undirected: bool = False) -> None:
     """OGB node-prediction raw csv.gz download -> flat npy layout."""
@@ -99,7 +142,9 @@ def convert_ogbn(raw: str, split: str, out: str,
     edges = _read_csv_gz(os.path.join(raw, "edge.csv.gz"), np.int64).T
     n = int(_read_csv_gz(os.path.join(raw, "num-node-list.csv.gz"),
                          np.int64).ravel()[0])
-    feat = _read_csv_gz(os.path.join(raw, "node-feat.csv.gz"), np.float32)
+    rows, dim = _stream_feat_csv_gz(os.path.join(raw, "node-feat.csv.gz"),
+                                    n, os.path.join(out, "feat.npy"))
+    print(f"  streamed feat.npy  shape=({rows}, {dim})")
     labels = _read_csv_gz(os.path.join(raw, "node-label.csv.gz"),
                           np.float32).ravel()
     # papers100M labels are float with NaN on unlabeled nodes.
@@ -114,11 +159,11 @@ def convert_ogbn(raw: str, split: str, out: str,
     _write(out, {
         "indptr": topo.indptr.astype(np.int64),
         "indices": topo.indices.astype(np.int32),
-        "feat": feat,
         "labels": labels,
         "train_idx": train_idx,
     }, {"source": "ogbn-raw", "num_nodes": n,
-        "num_edges": int(topo.num_edges), "undirected": undirected})
+        "num_edges": int(topo.num_edges), "undirected": undirected},
+        prewritten=("feat",))
 
 
 def convert_igbh(raw: str, out: str, classes: int = 19) -> None:
