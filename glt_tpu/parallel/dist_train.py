@@ -218,9 +218,14 @@ class _ColdStagePipeline:
         Host staging buffers may only be reused across batches when the
         device array made from them does not alias the host memory;
         zero-copy backends must fall back to fresh per-batch buffers.
-        Probed once: put, mutate the source, compare.
+        Probed once: put, mutate the source, compare.  The probe array
+        must be LARGE: CPU zero-copy aliasing only engages for
+        sufficiently-aligned buffers, and large numpy allocations are
+        page-aligned exactly like the real staging buffers — a small
+        probe can land on an unaligned pointer and falsely report copy
+        semantics.
         """
-        src = np.full((8,), 1.0, np.float32)
+        src = np.full((1 << 18,), 1.0, np.float32)   # 1 MB, page-aligned
         arr = jax.device_put(src)
         src[:] = 2.0
         return bool((np.asarray(arr) == 1.0).all())
