@@ -53,6 +53,11 @@ def main():
                          "(VERDICT r4 #5); numpy fancy indexing releases "
                          "the GIL, so the curve tracks host cores")
     ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--cold-alpha", type=float, default=2.0,
+                    help="staging capacity factor: cold_cap = alpha * cap."
+                         " The pipelines record max_cold_rows so a re-run"
+                         " can right-size this (the host->device feed"
+                         " scales with it)")
     ap.add_argument("--train-flops", type=float, default=2e9,
                     help="stand-in train step cost (flops)")
     args = ap.parse_args()
@@ -114,7 +119,7 @@ def main():
                                  nodes_per_shard=c, hot_per_shard=h,
                                  num_shards=S)
         store = HostColdStore(f)
-        cold_cap = 2 * args.cap    # the pipeline's default alpha=2
+        cold_cap = int(args.cold_alpha * args.cap)
 
         def route_body(nodes):
             req = route_cold_requests(nodes[0], c, h, S, "shard")

@@ -68,6 +68,12 @@ def main():
     # --no-pipelined runs the two-program loader path.
     ap.add_argument("--pipelined", action=argparse.BooleanOptionalAction,
                     default=True)
+    # G-batch scan: one program trains --group consecutive batches
+    # (sample+gather+fwd/bwd+update under lax.scan) — amortises host
+    # dispatch + seed feeds; equivalence tested exactly
+    # (tests/test_models.py::test_scanned_node_step_matches_serial).
+    ap.add_argument("--group", type=int, default=0,
+                    help="scan G batches per program (0 = fused pipeline)")
     # Exact final-hop dedup is the default; --no-last-hop-dedup opts into
     # the leaf-block fast mode (tree-unrolled GraphSAGE semantics).
     ap.add_argument("--last-hop-dedup",
@@ -89,6 +95,7 @@ def main():
     tx = optax.adam(1e-3)
 
     node_cap = args.node_cap
+    probe = None
     if node_cap is None and args.auto_cap:
         from glt_tpu.sampler import calibrate_node_capacity
 
@@ -105,24 +112,67 @@ def main():
         print(f"auto-cap: node_capacity {node_cap} "
               f"({node_cap / probe.full_node_capacity:.0%} of worst-case "
               f"{probe.full_node_capacity})")
+        if node_cap >= probe.full_node_capacity:
+            # No headroom at this scale (see BASELINE.md "Occupancy
+            # finding") — reuse the probe so its compiled program serves
+            # the training pipeline instead of compiling a twin.
+            node_cap = None
 
-    if args.pipelined:
-        sampler = NeighborSampler(ds.get_graph(), args.fanout,
-                                  batch_size=args.batch_size,
-                                  frontier_cap=args.frontier_cap,
-                                  with_edge=False,
-                                  last_hop_dedup=args.last_hop_dedup,
-                                  node_capacity=node_cap)
+    def build_sampler_and_state():
+        """Shared by the --group and pipelined branches."""
+        from glt_tpu.models import TrainState
+
+        sampler = probe if (probe is not None and node_cap is None) else \
+            NeighborSampler(ds.get_graph(), args.fanout,
+                            batch_size=args.batch_size,
+                            frontier_cap=args.frontier_cap,
+                            with_edge=False,
+                            last_hop_dedup=args.last_hop_dedup,
+                            node_capacity=node_cap)
         feat = ds.get_node_feature()
         labels = np.asarray(ds.get_node_label())
         x0 = jax.numpy.zeros((sampler.node_capacity, feat.shape[1]),
                              feat.dtype)
-        ei0 = jax.numpy.full((2, sampler.edge_capacity), -1, jax.numpy.int32)
+        ei0 = jax.numpy.full((2, sampler.edge_capacity), -1,
+                             jax.numpy.int32)
         m0 = jax.numpy.zeros((sampler.edge_capacity,), bool)
         params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
-        from glt_tpu.models import TrainState
         state = TrainState(params=params, opt_state=tx.init(params),
                            step=jax.numpy.zeros((), jax.numpy.int32))
+        return sampler, feat, labels, state
+
+    if args.group > 0:
+        from glt_tpu.models import (
+            make_scanned_node_train_step,
+            node_seed_blocks,
+        )
+
+        sampler, feat, labels, state = build_sampler_and_state()
+        sstep = make_scanned_node_train_step(
+            model, tx, sampler, feat, labels, args.batch_size)
+        rng = np.random.default_rng(0)
+        # Trailing blocks are -1 padded to [G, B]; only count real
+        # batches in the epoch metrics.
+        n_real = -(-len(train_idx) // args.batch_size)
+
+        def run_epoch(state, epoch):
+            losses, accs, ovfs = [], [], []
+            for i, blk in enumerate(node_seed_blocks(
+                    train_idx, args.batch_size, args.group, rng)):
+                state, ls, acs, ov = sstep(
+                    state, blk,
+                    jax.random.fold_in(jax.random.PRNGKey(100 + epoch), i))
+                losses += list(ls)
+                accs += list(acs)
+                ovfs.append(ov)
+            losses, accs = losses[:n_real], accs[:n_real]
+            ovf = int(np.asarray(
+                jax.device_get(jax.numpy.concatenate(ovfs))).sum())
+            if ovf:
+                print(f"  overflow batches: {ovf}/{n_real}")
+            return state, losses, accs
+    elif args.pipelined:
+        sampler, feat, labels, state = build_sampler_and_state()
         step, sample_first = make_pipelined_train_step(
             model, tx, sampler, feat, labels, args.batch_size)
         rng = np.random.default_rng(0)

@@ -10,6 +10,8 @@ from .train import (
     link_seed_blocks,
     make_pipelined_train_step,
     make_scanned_link_train_step,
+    make_scanned_node_train_step,
+    node_seed_blocks,
     make_scanned_subgraph_train_step,
     make_train_step,
     run_pipelined_epoch,
@@ -31,6 +33,8 @@ __all__ = [
     "make_eval_step",
     "make_pipelined_train_step",
     "make_scanned_link_train_step",
+    "make_scanned_node_train_step",
+    "node_seed_blocks",
     "make_scanned_subgraph_train_step",
     "make_train_step",
     "run_pipelined_epoch",
