@@ -45,10 +45,13 @@ def main():
     # G link batches per device program (amortises dispatch — the small
     # batches here are dispatch-bound); 0 = per-batch loader loop.
     ap.add_argument("--group", type=int, default=8)
+    # bf16 matmuls (f32 params/aggregation/loss); see glt_tpu/models/conv.py.
+    ap.add_argument("--bf16", action="store_true")
     args = ap.parse_args()
 
     ds, edge_index = synthetic_ppi(scale=args.scale)
-    model = GraphSAGE(hidden_features=64, out_features=64, num_layers=2,
+    model = GraphSAGE(dtype=jax.numpy.bfloat16 if args.bf16 else None,
+                      hidden_features=64, out_features=64, num_layers=2,
                       dropout_rate=0.0)
     tx = optax.adam(1e-3)
     neg = NegativeSampling("binary", 1)

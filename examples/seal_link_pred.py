@@ -30,6 +30,8 @@ def main():
     # G subgraph batches per device program (amortises per-call dispatch
     # — SEAL batches are tiny); 0 = per-batch loader loop.
     ap.add_argument("--group", type=int, default=8)
+    # bf16 matmuls (f32 params/aggregation/loss); see glt_tpu/models/conv.py.
+    ap.add_argument("--bf16", action="store_true")
     args = ap.parse_args()
 
     ds, edge_index = synthetic_ppi(scale=args.scale)
@@ -49,7 +51,8 @@ def main():
     loader = SubGraphLoader(ds, [8, 8], links.T.reshape(-1),
                             batch_size=args.batch_size * 2, max_degree=16)
 
-    model = GraphSAGE(hidden_features=32, out_features=32, num_layers=2,
+    model = GraphSAGE(dtype=jax.numpy.bfloat16 if args.bf16 else None,
+                      hidden_features=32, out_features=32, num_layers=2,
                       dropout_rate=0.0)
     head_tx = optax.adam(1e-3)
 
@@ -120,7 +123,8 @@ def run_scanned(args, ds, links, labels, rng):
     sampler = NeighborSampler(ds.get_graph(), [8, 8],
                               batch_size=seed_width, with_edge=True)
     feat = ds.get_node_feature()
-    model = GraphSAGE(hidden_features=32, out_features=32, num_layers=2,
+    model = GraphSAGE(dtype=jax.numpy.bfloat16 if args.bf16 else None,
+                      hidden_features=32, out_features=32, num_layers=2,
                       dropout_rate=0.0)
     tx = optax.adam(1e-3)
 

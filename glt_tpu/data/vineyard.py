@@ -123,8 +123,15 @@ class ArrowFragmentAdapter:
             return self._adj_cache[key]
         nbrs, eids = [], []
         has_eid = True
+        try:
+            adj = self._f.GetOutgoingAdjList
+        except AttributeError:
+            # Fragments loaded without edge ids may expose only the raw
+            # adjacency surface (vineyard_utils.cc:83-92).
+            adj = self._f.GetOutgoingRawAdjList
+            has_eid = False
         for v in self._f.InnerVertices(v_label):
-            for e in self._f.GetOutgoingAdjList(v, e_label):
+            for e in adj(v, e_label):
                 nbrs.append(int(e.get_neighbor().GetValue()))
                 if has_eid:
                     try:
@@ -144,7 +151,16 @@ class ArrowFragmentAdapter:
 
     # -- property columns (LoadVertex/EdgeFeatures, :130-189) ------------
     @staticmethod
-    def _table_columns(tbl) -> Dict[str, np.ndarray]:
+    def _chunk_to_numpy(chunk) -> np.ndarray:
+        if hasattr(chunk, "to_numpy"):
+            try:  # arrow arrays need zero_copy_only=False for strings
+                return np.asarray(chunk.to_numpy(zero_copy_only=False))
+            except TypeError:
+                return np.asarray(chunk.to_numpy())
+        return np.asarray(chunk)
+
+    @classmethod
+    def _table_columns(cls, tbl) -> Dict[str, np.ndarray]:
         names = (list(tbl.ColumnNames()) if hasattr(tbl, "ColumnNames")
                  else list(tbl.column_names))
         cols = {}
@@ -152,13 +168,18 @@ class ArrowFragmentAdapter:
             col = (tbl.GetColumnByName(name)
                    if hasattr(tbl, "GetColumnByName")
                    else tbl.column(name))
-            chunk = col.chunk(0) if hasattr(col, "chunk") else col
-            if hasattr(chunk, "to_numpy"):
-                try:  # arrow arrays need zero_copy_only=False for strings
-                    chunk = chunk.to_numpy(zero_copy_only=False)
-                except TypeError:
-                    chunk = chunk.to_numpy()
-            cols[name] = np.asarray(chunk)
+            # Arrow ChunkedArrays hold MULTIPLE chunks at fragment scale
+            # (one per record batch) — concatenate them all; a
+            # first-chunk-only read silently truncates the table.
+            if hasattr(col, "num_chunks"):
+                parts = [cls._chunk_to_numpy(col.chunk(i))
+                         for i in range(col.num_chunks)]
+                cols[name] = (parts[0] if len(parts) == 1
+                              else np.concatenate(parts))
+            elif hasattr(col, "chunk"):
+                cols[name] = cls._chunk_to_numpy(col.chunk(0))
+            else:
+                cols[name] = cls._chunk_to_numpy(col)
         return cols
 
     def vertex_columns(self, v_label):

@@ -286,12 +286,23 @@ def make_scanned_node_train_step(model, tx, sampler, rows, labels,
 
             (loss, acc), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(st.params)
-            updates, opt_state = tx.update(grads, st.opt_state, st.params)
-            params = optax.apply_updates(st.params, updates)
+
+            def apply(s):
+                updates, opt_state = tx.update(grads, s.opt_state,
+                                               s.params)
+                params = optax.apply_updates(s.params, updates)
+                return TrainState(params, opt_state, s.step + 1)
+
+            # Fully-padded trailing batches (block padding) must be
+            # no-ops: their grads are zero, but a stateful optimizer
+            # (adam momentum decay) would still move params and the step
+            # bump would shift later dropout keys — gating keeps the
+            # scanned path equivalent to the serial loop over REAL
+            # batches only.
+            st = jax.lax.cond(jnp.any(seeds >= 0), apply, lambda s: s, st)
             ovf = (out.metadata["overflow"].astype(jnp.int32)
                    if out.metadata else jnp.zeros((), jnp.int32))
-            return (TrainState(params, opt_state, st.step + 1),
-                    (loss, acc, ovf))
+            return st, (loss, acc, ovf)
 
         keys = jax.random.split(key, seeds_blk.shape[0])
         state, (losses, accs, ovfs) = jax.lax.scan(body, state,

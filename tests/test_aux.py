@@ -221,10 +221,14 @@ class TestVineyardConnector:
             def edge_id(self): return self._e
 
         class _Chunked:
-            def __init__(self, arr): self._a = np.asarray(arr)
-            def chunk(self, i):
-                assert i == 0
-                return self._a
+            """Two-chunk column (the multi-record-batch case)."""
+            def __init__(self, arr):
+                a = np.asarray(arr)
+                h = a.shape[0] // 2
+                self._chunks = [a[:h], a[h:]]
+            @property
+            def num_chunks(self): return len(self._chunks)
+            def chunk(self, i): return self._chunks[i]
 
         class _Table:
             def __init__(self, cols): self._c = cols

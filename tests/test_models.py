@@ -332,3 +332,60 @@ def test_scanned_node_step_matches_serial():
         s_losses.append(float(loss))
     assert g_losses == pytest.approx(s_losses, rel=1e-6), (g_losses,
                                                            s_losses)
+
+
+def test_scanned_node_step_padded_batch_is_noop():
+    """A fully -1-padded trailing batch in a scan block must not move
+    params or the step counter (adam momentum would otherwise drift on
+    zero grads)."""
+    from glt_tpu.models import (
+        TrainState,
+        make_scanned_node_train_step,
+        make_train_step,
+        node_seed_blocks,
+    )
+    from glt_tpu.loader.transform import to_batch
+    from glt_tpu.sampler import NeighborSampler
+    from glt_tpu.sampler.base import NodeSamplerInput
+
+    ds, labels = _cluster_dataset()
+    model = GraphSAGE(hidden_features=16, out_features=3, num_layers=2,
+                      dropout_rate=0.0)
+    tx = optax.adam(1e-2)
+    bs, G = 16, 2
+    sampler = NeighborSampler(ds.get_graph(), [4, 4], batch_size=bs,
+                              with_edge=False)
+    feat = ds.get_node_feature()
+    x0 = jnp.zeros((sampler.node_capacity, feat.shape[1]), jnp.float32)
+    ei0 = jnp.full((2, sampler.edge_capacity), -1, jnp.int32)
+    m0 = jnp.zeros((sampler.edge_capacity,), bool)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
+
+    def fresh_state():
+        return TrainState(params=params, opt_state=tx.init(params),
+                          step=jnp.zeros((), jnp.int32))
+
+    # 16 seeds, block [2, 16]: batch 1 is ENTIRELY padding.
+    rng = np.random.default_rng(0)
+    blocks = list(node_seed_blocks(np.arange(16), bs, G, rng))
+    assert (blocks[0][1] == -1).all()
+    base = jax.random.PRNGKey(5)
+    sstep = make_scanned_node_train_step(model, tx, sampler, feat, labels,
+                                         bs)
+    st, losses, accs, _ = sstep(fresh_state(), blocks[0], base)
+    assert int(st.step) == 1  # only the real batch stepped
+
+    # Equivalence with a serial run over the REAL batch only.
+    tstep = make_train_step(model, tx, batch_size=bs)
+    state = fresh_state()
+    keys = jax.random.split(base, G)
+    out = sampler.sample_from_nodes(
+        NodeSamplerInput(blocks[0][0].astype(np.int64)), key=keys[0])
+    x = feat.gather(out.node)
+    safe = jnp.clip(out.node, 0, len(labels) - 1)
+    y = jnp.where(out.node >= 0, jnp.take(jnp.asarray(labels), safe), -1)
+    state, loss, acc = tstep(state, to_batch(out, x=x, y=y, batch_size=bs))
+    np.testing.assert_allclose(float(losses[0]), float(loss), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(st.params),
+                    jax.tree_util.tree_leaves(state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
