@@ -627,6 +627,11 @@ def main():
         "epoch_best": round(min(epoch_s, epoch_scanned_s), 2),
         "epoch_best_path": (best_path if epoch_s <= epoch_scanned_s
                             else "scanned"),
+        # Steady-state per-batch overhead of the winning epoch path over
+        # the pure train step (the <20% target metric).
+        "sampling_overhead_frac_epoch": round(
+            (min(epoch_s, epoch_scanned_s) / n_epoch_batches * 1e3)
+            / max(capped["train_ms"], 1e-9) - 1.0, 3),
         "epoch_batches": n_epoch_batches,
         "epoch_s_est_config1": round(n_epoch_batches * best_step_ms / 1e3,
                                      2),
