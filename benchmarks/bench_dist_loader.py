@@ -164,11 +164,137 @@ def bench_mesh_sampler(args):
     }))
 
 
+def bench_hetero_mesh(args):
+    """Hetero bounded-exchange + tiered-staging characterisation
+    (VERDICT r4 #4 done-criterion): per-edge-type exchange bytes with and
+    without ``exchange_load_factor``, plus the per-type cold-stage vs
+    train split of the hetero tiered pipeline."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh
+
+    from glt_tpu.data.topology import CSRTopo
+    from glt_tpu.models.rgat import RGAT
+    from glt_tpu.parallel import (
+        DistHeteroNeighborSampler,
+        HeteroTieredTrainPipeline,
+        init_hetero_dist_state,
+        make_hetero_tiered_train_step,
+        shard_feature,
+        shard_feature_tiered,
+        shard_hetero_graph,
+    )
+
+    n_dev = min(8, len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("shard",))
+    rng = np.random.default_rng(0)
+    U, I, classes = 4096, 2048, 16
+    labels = (np.arange(U) % classes).astype(np.int32)
+    deg_ui = 6
+    u_src = np.repeat(np.arange(U), deg_ui)
+    i_dst = rng.integers(0, I, U * deg_ui)
+    ET_UI = ("user", "clicks", "item")
+    ET_IU = ("item", "rev_clicks", "user")
+    topos = {ET_UI: CSRTopo(np.stack([u_src, i_dst]), num_nodes=U),
+             ET_IU: CSRTopo(np.stack([i_dst, u_src]), num_nodes=I)}
+    sharded = shard_hetero_graph(topos, n_dev)
+    dim = 64
+    item_feat = rng.normal(size=(I, dim)).astype(np.float32)
+    user_feat = rng.normal(size=(U, dim)).astype(np.float32)
+    lab = jnp.asarray(labels.reshape(n_dev, -1))
+    bs = args.batch_size // 4 or 64
+    cu = -(-U // n_dev)
+    seed_batches = [
+        jnp.asarray(np.stack([
+            rng.integers(s * cu, min((s + 1) * cu, U), bs)
+            for s in range(n_dev)]).astype(np.int32))
+        for _ in range(args.iters + 2)]
+
+    def run(alpha):
+        samp = DistHeteroNeighborSampler(
+            sharded, mesh, args.fanout, "user", batch_size=bs,
+            exchange_load_factor=alpha, seed=0)
+
+        def batch_edges(out):
+            return sum(jnp.sum(m.astype(jnp.int32))
+                       for m in out.edge_mask.values())
+
+        # Warmup (compiles) — excluded from BOTH the timer and the
+        # edge/drop counters, matching bench_mesh_sampler.
+        tot = None
+        for sd in seed_batches[:2]:
+            e = batch_edges(samp.sample_from_nodes(sd))
+            tot = e if tot is None else tot + e
+        int(jax.device_get(tot))
+        tot = None
+        dropped = 0
+        t0 = time.perf_counter()
+        for sd in seed_batches[2:]:
+            out = samp.sample_from_nodes(sd)
+            e = batch_edges(out)
+            tot = e if tot is None else tot + e
+            if alpha is not None and out.metadata:
+                dropped += int(np.asarray(jax.device_get(
+                    out.metadata["exchange_dropped"])).sum())
+        edges = int(jax.device_get(tot))
+        return edges, time.perf_counter() - t0, dropped
+
+    edges, dt, _ = run(None)
+    alpha = args.exchange_load_factor
+    b_edges, b_dt, b_dropped = run(alpha)
+
+    # Tiered pipeline: item features host-tiered, one timed epoch.
+    feats = {"user": shard_feature(user_feat, n_dev),
+             "item": shard_feature_tiered(item_feat, n_dev,
+                                          hot_ratio=0.25)}
+    samp = DistHeteroNeighborSampler(sharded, mesh, args.fanout, "user",
+                                     batch_size=bs,
+                                     exchange_load_factor=alpha, seed=0)
+    model = RGAT(edge_types=[ET_IU, ET_UI], hidden_features=32,
+                 out_features=classes, target_type="user", num_layers=2,
+                 conv="gat", dropout_rate=0.0)
+    tx = optax.adam(1e-3)
+    state = init_hetero_dist_state(model, tx, samp, feats,
+                                   jax.random.PRNGKey(0))
+    train = make_hetero_tiered_train_step(model, tx, samp, feats, lab,
+                                          mesh, batch_size=bs)
+    pipe = HeteroTieredTrainPipeline(samp, train, feats, mesh)
+    batches_np = [np.asarray(b) for b in seed_batches]
+    state, losses, _ = pipe.run_epoch(state, batches_np[:2],
+                                      jax.random.PRNGKey(1))  # warm
+    float(jax.device_get(losses[-1]))
+    t0 = time.perf_counter()
+    state, losses, _ = pipe.run_epoch(state, batches_np,
+                                      jax.random.PRNGKey(2))
+    float(jax.device_get(losses[-1]))
+    tiered_dt = time.perf_counter() - t0
+    cold_drops = pipe.flush_dropped()
+    max_cold = dict(pipe.max_cold_rows)
+    pipe.close()
+
+    print(json.dumps({
+        "metric": "dist_hetero_mesh",
+        "devices": n_dev, "batch_size": bs, "fanout": args.fanout,
+        "m_edges_per_s_full": round(edges / dt / 1e6, 3),
+        "m_edges_per_s_bounded": round(b_edges / b_dt / 1e6, 3),
+        "exchange_load_factor": alpha,
+        "bounded_dropped_requests": b_dropped,
+        "bounded_sampled_edges_frac": round(b_edges / max(edges, 1), 4),
+        "tiered_epoch_s": round(tiered_dt, 3),
+        "tiered_ms_per_batch": round(
+            tiered_dt / len(batches_np) * 1e3, 2),
+        "tiered_cold_dropped": cold_drops,
+        "tiered_max_cold_rows": max_cold,
+        "note": "virtual CPU mesh unless run on a pod",
+    }))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--modes", nargs="+",
-                    default=["worker", "mesh"],
-                    choices=["worker", "mesh"])
+                    default=["worker", "mesh", "hetero"],
+                    choices=["worker", "mesh", "hetero"])
     ap.add_argument("--fanout", type=int, nargs="+", default=[10, 5])
     ap.add_argument("--batch-size", type=int, default=256)
     ap.add_argument("--num-seeds", type=int, default=4096)
@@ -201,6 +327,8 @@ def main():
         bench_worker_mode(args)
     if "mesh" in args.modes:
         bench_mesh_sampler(args)
+    if "hetero" in args.modes:
+        bench_hetero_mesh(args)
 
 
 if __name__ == "__main__":

@@ -9,6 +9,8 @@ from .train import (
     make_eval_step,
     link_seed_blocks,
     make_pipelined_train_step,
+    init_hetero_state,
+    make_scanned_hetero_train_step,
     make_scanned_link_train_step,
     make_scanned_node_train_step,
     node_seed_blocks,
@@ -32,6 +34,8 @@ __all__ = [
     "link_seed_blocks",
     "make_eval_step",
     "make_pipelined_train_step",
+    "init_hetero_state",
+    "make_scanned_hetero_train_step",
     "make_scanned_link_train_step",
     "make_scanned_node_train_step",
     "node_seed_blocks",

@@ -56,12 +56,21 @@ def scatter_mean(msgs: jnp.ndarray, dst: jnp.ndarray, num_nodes: int,
 
 def segment_softmax(scores: jnp.ndarray, seg: jnp.ndarray, num_segments: int,
                     mask: jnp.ndarray) -> jnp.ndarray:
-    """Numerically-stable softmax over edges grouped by destination."""
+    """Numerically-stable softmax over edges grouped by destination.
+
+    The exponent is clamped at 0 BEFORE ``exp``: valid lanes satisfy
+    ``score <= smax`` by construction (no-op), but masked lanes route to
+    the spill segment whose max is reset to 0 — once attention scores
+    grow past ~88, ``exp`` of those discarded lanes overflows to inf and
+    the ``where`` backward turns 0-cotangent x inf into NaN grads
+    (observed on TPU at config-4 scale 10, batch 136).
+    """
     seg_safe = jnp.where(mask, seg, num_segments)
     smax = jax.ops.segment_max(jnp.where(mask, scores, -jnp.inf), seg_safe,
                                num_segments=num_segments + 1)
     smax = jnp.where(jnp.isfinite(smax), smax, 0)
-    ex = jnp.where(mask, jnp.exp(scores - smax[seg_safe]), 0)
+    ex = jnp.where(mask,
+                   jnp.exp(jnp.minimum(scores - smax[seg_safe], 0.0)), 0)
     denom = jax.ops.segment_sum(ex, seg_safe, num_segments=num_segments + 1)
     return ex / jnp.maximum(denom[seg_safe], 1e-16)
 

@@ -99,7 +99,13 @@ class HGTConv(nn.Module):
             num = jnp.zeros((n_t + 1, h, d))
             for score, msg, d_idx, mask in items:
                 seg = jnp.where(mask, d_idx, n_t)
-                ex = jnp.where(mask[:, None], jnp.exp(score - m[seg]), 0)
+                # Clamp the exponent at 0: valid lanes have score <= m;
+                # masked lanes hit the spill row's reset max and would
+                # otherwise overflow exp -> inf -> NaN grads through the
+                # where backward (see conv.segment_softmax).
+                ex = jnp.where(mask[:, None],
+                               jnp.exp(jnp.minimum(score - m[seg], 0.0)),
+                               0)
                 denom = denom + jax.ops.segment_sum(
                     ex, seg, num_segments=n_t + 1)
                 num = num + jax.ops.segment_sum(
@@ -111,7 +117,9 @@ class HGTConv(nn.Module):
             att_sum = jnp.zeros((n_t + 1, h))
             for score, _, d_idx, mask in items:
                 seg = jnp.where(mask, d_idx, n_t)
-                ex = jnp.where(mask[:, None], jnp.exp(score - m[seg]), 0)
+                ex = jnp.where(mask[:, None],
+                               jnp.exp(jnp.minimum(score - m[seg], 0.0)),
+                               0)
                 att_sum = att_sum + jax.ops.segment_sum(
                     ex / jnp.maximum(denom, 1e-16)[seg], seg,
                     num_segments=n_t + 1)

@@ -775,24 +775,12 @@ def init_hetero_dist_state(model, tx, sampler, feats,
 
     ``feats`` values may be :class:`ShardedFeature` or
     :class:`TieredShardedFeature`."""
-    capacity = sampler.node_capacity
-    widths = sampler.hop_widths
+    from ..models.train import hetero_init_shapes
 
     def _rows(f):
         return f.hot if isinstance(f, TieredShardedFeature) else f.rows
 
-    x = {t: jnp.zeros((max(capacity[t], 1),
-                       _rows(feats[t]).shape[-1]), _rows(feats[t]).dtype)
-         for t in feats}
-    ei, mask = {}, {}
-    from ..typing import reverse_edge_type
-    for et in sampler.edge_types:
-        fanouts = sampler.num_neighbors[et]
-        ecap = sum(widths[hop][et[0]] * f
-                   for hop, f in enumerate(fanouts) if f > 0)
-        rev = reverse_edge_type(et)
-        ei[rev] = jnp.full((2, max(ecap, 1)), PADDING_ID, jnp.int32)
-        mask[rev] = jnp.zeros((max(ecap, 1),), bool)
+    x, ei, mask = hetero_init_shapes(sampler, feats, _rows)
     params = model.init({"params": rng}, x, ei, mask)
     return TrainState(params=params, opt_state=tx.init(params),
                       step=jnp.zeros((), jnp.int32))

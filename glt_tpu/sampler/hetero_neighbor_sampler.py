@@ -147,6 +147,17 @@ class HeteroNeighborSampler(BaseSampler):
             partial(self._sample_impl, self._widths, self._capacity))
         self._edges_jit = {}
 
+    @property
+    def node_capacity(self) -> Dict[NodeType, int]:
+        """Static per-node-type unique-node capacity (mirrors the
+        distributed sampler's property — shared by state initializers)."""
+        return dict(self._capacity)
+
+    @property
+    def hop_widths(self) -> List[Dict[NodeType, int]]:
+        """Per-hop per-node-type frontier widths (static trace shapes)."""
+        return [dict(w) for w in self._widths]
+
     def _next_key(self) -> jax.Array:
         key = jax.random.fold_in(self._base_key, self._call_count)
         self._call_count += 1

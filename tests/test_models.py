@@ -389,3 +389,47 @@ def test_scanned_node_step_padded_batch_is_noop():
     for a, b in zip(jax.tree_util.tree_leaves(st.params),
                     jax.tree_util.tree_leaves(state.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_gat_grads_finite_with_large_scores():
+    """Regression (r5, config-4 scale 10 on TPU): once attention scores
+    exceed ~88, masked spill lanes computed exp(score - 0) = inf, and the
+    where backward turned 0-cotangent x inf into NaN grads.  Scaled-up
+    attention params must yield finite grads."""
+    from glt_tpu.models.conv import GATConv
+
+    model = GATConv(out_features=4, heads=2)
+    x = jnp.ones((6, 3)) * 10.0
+    ei = jnp.array([[1, 2, 3, -1, -1], [0, 0, 1, -1, -1]])
+    mask = ei[0] >= 0
+    params = model.init(jax.random.PRNGKey(0), x, ei, mask)
+    # Inflate attention parameters so raw scores overflow exp by far.
+    params = jax.tree_util.tree_map(lambda p: p * 100.0, params)
+
+    def loss(p):
+        return model.apply(p, x, ei, mask).sum()
+
+    g = jax.grad(loss)(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_hgt_grads_finite_with_large_scores():
+    """Same spill-lane exp-overflow regression for HGT's joint softmax."""
+    from glt_tpu.models.hgt import HGT
+
+    ET = ("a", "r", "b")
+    model = HGT(edge_types=[ET], hidden_features=8, out_features=3,
+                target_type="b", num_layers=1, heads=2, dropout_rate=0.0)
+    x = {"a": jnp.ones((5, 4)) * 10.0, "b": jnp.ones((4, 4)) * 10.0}
+    ei = {ET: jnp.array([[0, 1, 2, -1], [0, 1, 1, -1]])}
+    mask = {ET: ei[ET][0] >= 0}
+    params = model.init(jax.random.PRNGKey(0), x, ei, mask)
+    params = jax.tree_util.tree_map(lambda p: p * 50.0, params)
+
+    def loss(p):
+        return model.apply(p, x, ei, mask).sum()
+
+    g = jax.grad(loss)(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
