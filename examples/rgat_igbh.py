@@ -144,7 +144,7 @@ def main():
         from glt_tpu.models import (
             init_hetero_state,
             make_scanned_hetero_train_step,
-            node_seed_blocks,
+            run_scanned_epoch,
         )
         from glt_tpu.sampler.hetero_neighbor_sampler import (
             HeteroNeighborSampler,
@@ -162,24 +162,14 @@ def main():
         sstep = make_scanned_hetero_train_step(
             model, tx, sampler, feats, labels, args.batch_size)
         rng = np.random.default_rng(0)
-        n_real = -(-len(train_idx) // args.batch_size)
         for epoch in range(args.epochs):
             t0 = time.perf_counter()
-            losses, accs = [], []
-            for i, blk in enumerate(node_seed_blocks(
-                    train_idx, args.batch_size, args.group, rng)):
-                state, ls, acs = sstep(
-                    state, blk,
-                    jax.random.fold_in(jax.random.PRNGKey(100 + epoch),
-                                       i))
-                losses += list(ls)
-                accs += list(acs)
-            losses, accs = losses[:n_real], accs[:n_real]
-            jax.device_get(losses[-1])
-            print(f"epoch {epoch}: "
-                  f"loss={float(np.mean(jax.device_get(losses))):.4f} "
-                  f"acc={float(np.mean(jax.device_get(accs))):.4f} "
-                  f"time={time.perf_counter() - t0:.2f}s")
+            state, losses, accs, _ = run_scanned_epoch(
+                sstep, state, train_idx, args.batch_size, args.group,
+                rng, jax.random.PRNGKey(100 + epoch))
+            dt = time.perf_counter() - t0
+            print(f"epoch {epoch}: loss={float(np.mean(losses)):.4f} "
+                  f"acc={float(np.mean(accs)):.4f} time={dt:.2f}s")
         return
 
     loader = HeteroNeighborLoader(ds, [4, 4], ("paper", train_idx),

@@ -34,19 +34,58 @@ def main():
                     default=True,
                     help="exact final-hop dedup (default); "
                          "--no-last-hop-dedup opts into the fast leaf block")
+    # G-batch scan: one program trains --group consecutive hetero
+    # batches (see rgat_igbh.py — per-batch dispatch dominates small
+    # hetero batches on TPU).  0 = eager loader loop.
+    ap.add_argument("--group", type=int, default=8)
+    ap.add_argument("--bf16", action="store_true")
     args = ap.parse_args()
 
     ds, train_idx, classes = synthetic_mag(scale=args.scale)
-    loader = HeteroNeighborLoader(
-        ds, args.fanout, ("paper", train_idx),
-        batch_size=args.batch_size, shuffle=True, seed=0,
-        last_hop_dedup=args.last_hop_dedup)
     batch_ets = sorted(reverse_edge_type(et) for et in ds.graph)
 
     model = HGT(edge_types=batch_ets, hidden_features=args.hidden,
                 out_features=classes, target_type="paper",
                 num_layers=len(args.fanout), heads=args.heads,
-                dropout_rate=0.3)
+                dropout_rate=0.3,
+                dtype=jnp.bfloat16 if args.bf16 else None)
+
+    if args.group > 0:
+        from glt_tpu.models import (
+            init_hetero_state,
+            make_scanned_hetero_train_step,
+            run_scanned_epoch,
+        )
+        from glt_tpu.sampler.hetero_neighbor_sampler import (
+            HeteroNeighborSampler,
+        )
+
+        sampler = HeteroNeighborSampler(
+            ds.graph, args.fanout, "paper", batch_size=args.batch_size,
+            seed=0, last_hop_dedup=args.last_hop_dedup)
+        feats = {t: ds.get_node_feature(t) for t in ds.get_node_types()}
+        labels = {"paper": np.asarray(ds.node_labels["paper"])}
+        tx = optax.adam(1e-3)
+        state = init_hetero_state(model, tx, sampler, feats,
+                                  jax.random.PRNGKey(0))
+        sstep = make_scanned_hetero_train_step(
+            model, tx, sampler, feats, labels, args.batch_size)
+        rng = np.random.default_rng(0)
+        for epoch in range(args.epochs):
+            t0 = time.perf_counter()
+            state, losses, accs, _ = run_scanned_epoch(
+                sstep, state, train_idx, args.batch_size, args.group,
+                rng, jax.random.PRNGKey(100 + epoch))
+            dt = time.perf_counter() - t0
+            print(f"epoch {epoch}: loss {float(np.mean(losses)):.4f} "
+                  f"acc {float(np.mean(accs)):.4f} "
+                  f"({dt:.2f}s, {len(losses)} batches)")
+        return
+
+    loader = HeteroNeighborLoader(
+        ds, args.fanout, ("paper", train_idx),
+        batch_size=args.batch_size, shuffle=True, seed=0,
+        last_hop_dedup=args.last_hop_dedup)
     first = next(iter(loader))
     tx = optax.adam(1e-3)
     params = model.init({"params": jax.random.PRNGKey(0)}, first.x,

@@ -145,33 +145,21 @@ def main():
     if args.group > 0:
         from glt_tpu.models import (
             make_scanned_node_train_step,
-            node_seed_blocks,
+            run_scanned_epoch,
         )
 
         sampler, feat, labels, state = build_sampler_and_state()
         sstep = make_scanned_node_train_step(
             model, tx, sampler, feat, labels, args.batch_size)
         rng = np.random.default_rng(0)
-        # Trailing blocks are -1 padded to [G, B]; only count real
-        # batches in the epoch metrics.
-        n_real = -(-len(train_idx) // args.batch_size)
 
         def run_epoch(state, epoch):
-            losses, accs, ovfs = [], [], []
-            for i, blk in enumerate(node_seed_blocks(
-                    train_idx, args.batch_size, args.group, rng)):
-                state, ls, acs, ov = sstep(
-                    state, blk,
-                    jax.random.fold_in(jax.random.PRNGKey(100 + epoch), i))
-                losses += list(ls)
-                accs += list(acs)
-                ovfs.append(ov)
-            losses, accs = losses[:n_real], accs[:n_real]
-            ovf = int(np.asarray(
-                jax.device_get(jax.numpy.concatenate(ovfs))).sum())
+            state, losses, accs, ovf = run_scanned_epoch(
+                sstep, state, train_idx, args.batch_size, args.group,
+                rng, jax.random.PRNGKey(100 + epoch))
             if ovf:
-                print(f"  overflow batches: {ovf}/{n_real}")
-            return state, losses, accs
+                print(f"  overflow batches: {ovf}/{len(losses)}")
+            return state, list(losses), list(accs)
     elif args.pipelined:
         sampler, feat, labels, state = build_sampler_and_state()
         step, sample_first = make_pipelined_train_step(

@@ -14,6 +14,7 @@ from .train import (
     make_scanned_link_train_step,
     make_scanned_node_train_step,
     node_seed_blocks,
+    run_scanned_epoch,
     make_scanned_subgraph_train_step,
     make_train_step,
     run_pipelined_epoch,
@@ -39,6 +40,7 @@ __all__ = [
     "make_scanned_link_train_step",
     "make_scanned_node_train_step",
     "node_seed_blocks",
+    "run_scanned_epoch",
     "make_scanned_subgraph_train_step",
     "make_train_step",
     "run_pipelined_epoch",

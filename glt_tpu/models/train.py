@@ -330,6 +330,39 @@ def node_seed_blocks(train_idx, batch_size: int, group: int, rng):
         yield blk
 
 
+def run_scanned_epoch(step, state, train_idx, batch_size: int,
+                      group: int, rng, base_key):
+    """One epoch through a scanned train step (node or hetero variant).
+
+    Shuffles ``train_idx`` into ``[G, B]`` blocks, pre-stages them to
+    the device, drives ``step`` per block, and reduces the metrics with
+    ONE device concat + ONE host fetch — per-element ``list(ls)`` slices
+    and per-array fetches both put tunnel round trips on the critical
+    path.  Returns ``(state, losses [n_real], accs [n_real],
+    overflow_count)`` as host numpy (the fetch is the epoch's sync
+    point); ``overflow_count`` is 0 for steps without an overflow
+    channel.
+    """
+    import numpy as np
+
+    blocks = [jax.device_put(jnp.asarray(b.astype(np.int32)))
+              for b in node_seed_blocks(train_idx, batch_size, group, rng)]
+    n_real = -(-len(train_idx) // batch_size)
+    losses, accs, ovfs = [], [], []
+    for i, blk in enumerate(blocks):
+        res = step(state, blk, jax.random.fold_in(base_key, i))
+        state = res[0]
+        losses.append(res[1])
+        accs.append(res[2])
+        if len(res) > 3:
+            ovfs.append(res[3])
+    losses = np.asarray(jax.device_get(jnp.concatenate(losses)))[:n_real]
+    accs = np.asarray(jax.device_get(jnp.concatenate(accs)))[:n_real]
+    ovf = (int(np.asarray(jax.device_get(
+        jnp.concatenate(ovfs))).sum()) if ovfs else 0)
+    return state, losses, accs, ovf
+
+
 def hetero_init_shapes(sampler, feats, rows_of):
     """Zero-filled ``(x, edge_index, edge_mask)`` dummies matching a
     hetero sampler's static output shapes — the shared shape builder for
