@@ -86,17 +86,23 @@ def main():
 
         for epoch in range(args.epochs):
             t0 = time.perf_counter()
-            losses, batches = [], 0
+            losses, nbs, batches = [], [], 0
             for sb, db, nb in link_seed_blocks(edge_index, args.batch_size,
                                                args.group, rng):
                 params, opt_state, ls = step(
                     params, opt_state, sb, db,
                     jax.random.fold_in(jax.random.PRNGKey(epoch), batches))
-                losses.append(ls[:nb])
+                # Whole [G] blocks: per-block slices + fetches would put
+                # a dispatch/round-trip per block on the critical path
+                # (see glt_tpu.models.run_scanned_epoch).
+                losses.append(ls)
+                nbs.append(nb)
                 batches += nb
-            jax.device_get(losses[-1])
-            mean = float(np.mean(np.concatenate(
-                [np.asarray(jax.device_get(l)) for l in losses])))
+            flat = np.asarray(jax.device_get(jnp.concatenate(losses)))
+            valid = np.concatenate(
+                [np.arange(nb) + i * args.group
+                 for i, nb in enumerate(nbs)])
+            mean = float(np.mean(flat[valid]))
             print(f"epoch {epoch}: loss={mean:.4f} "
                   f"time={time.perf_counter() - t0:.2f}s")
         return

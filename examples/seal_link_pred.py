@@ -152,7 +152,7 @@ def run_scanned(args, ds, links, labels, rng):
     for epoch in range(args.epochs):
         t0 = time.perf_counter()
         order = rng.permutation(m2)
-        losses, nb = [], 0
+        losses, nbs, nb = [], [], 0
         per_block = bs * G
         for lo in range(0, m2, per_block):
             sel = order[lo: lo + per_block]
@@ -165,11 +165,15 @@ def run_scanned(args, ds, links, labels, rng):
             params, opt_state, ls = step(
                 params, opt_state, sb, yb,
                 jax.random.fold_in(jax.random.PRNGKey(epoch), lo))
-            losses.append(ls[: -(-k // bs)])
+            # Whole [G] blocks; one concat + one fetch below (see
+            # glt_tpu.models.run_scanned_epoch).
+            losses.append(ls)
+            nbs.append(-(-k // bs))
             nb += -(-k // bs)
-        jax.device_get(losses[-1])
-        mean = float(np.mean(np.concatenate(
-            [np.asarray(jax.device_get(l)) for l in losses])))
+        flat = np.asarray(jax.device_get(jnp.concatenate(losses)))
+        valid = np.concatenate(
+            [np.arange(b) + i * G for i, b in enumerate(nbs)])
+        mean = float(np.mean(flat[valid]))
         print(f"epoch {epoch}: loss={mean:.4f} "
               f"time={time.perf_counter() - t0:.2f}s")
 
