@@ -256,9 +256,13 @@ def main():
     from glt_tpu.utils import profile
 
     meter = profile.ThroughputMeter()
+    # One stateful Generator across epochs (identically seeded on every
+    # host): each epoch draws a fresh permutation from the advancing
+    # stream instead of re-deriving one from the epoch index.
+    shuffle_rng = np.random.default_rng(0)
     for epoch in range(args.epochs):
         batches = ds.split_seeds(train_idx, args.batch_size, shuffle=True,
-                                 seed=epoch)
+                                 rng=shuffle_rng)
         with meter.measure():
             t0 = time.perf_counter()
             state, losses, accs = run_epoch(state, batches,

@@ -1,0 +1,44 @@
+"""gltlint — TPU/JAX-aware static analysis for the glt_tpu data engine.
+
+An AST pass over the whole package that catches the silent hazards a TPU
+deployment hits at runtime (or never notices): host syncs inside jitted
+sampling programs, PRNG key reuse that correlates neighbor draws, Python
+scalars baked into traces (recompile storms), int64 id truncation under
+x64-disabled JAX, unseeded host RNGs, and use-after-donation.
+
+Usage::
+
+    python -m glt_tpu.analysis [paths...]      # CI gate: exit 1 on errors
+    python -m glt_tpu.analysis --list-rules
+
+Programmatic::
+
+    from glt_tpu.analysis import analyze_source, analyze_paths
+    findings = analyze_source(src, "module.py")
+
+Suppression (justify every one)::
+
+    x = np.asarray(host_value)  # gltlint: disable=host-sync-in-jit -- host-side branch
+
+See ``docs/analysis.md`` for each rule's TPU failure mode.
+
+This subpackage analyzes with stdlib ``ast`` only and never imports JAX
+— the lint runs in CI images with no accelerator stack (numpy, pulled in
+by the parent package, is its only third-party import).
+"""
+from .cli import analyze_paths, analyze_source, main
+from .report import Finding, Severity, Suppressions, format_report
+from .rules import RULES, Rule, all_rules
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "Rule",
+    "Severity",
+    "Suppressions",
+    "all_rules",
+    "analyze_paths",
+    "analyze_source",
+    "format_report",
+    "main",
+]

@@ -1,0 +1,6 @@
+"""``python -m glt_tpu.analysis`` entry point."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

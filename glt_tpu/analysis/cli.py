@@ -1,0 +1,133 @@
+"""gltlint command line: ``python -m glt_tpu.analysis [paths]``.
+
+Exit codes: 0 = clean (or warnings only), 1 = at least one ERROR finding,
+2 = usage/parse problems (a file that cannot be parsed is reported as an
+error finding, not a crash — CI must not go green on a syntax error).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Iterable, List, Optional, Sequence
+
+from .report import (
+    Finding,
+    Severity,
+    Suppressions,
+    apply_suppressions,
+    format_report,
+)
+from .rules import RULES, Rule, all_rules
+from .visitor import ModuleInfo
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   rules: Optional[Sequence[Rule]] = None,
+                   suppress: bool = True) -> List[Finding]:
+    """Run the given rules (default: all) over one module's source."""
+    rules = list(rules) if rules is not None else all_rules()
+    try:
+        module = ModuleInfo(path, source)
+    except SyntaxError as exc:
+        return [Finding(path=path, line=exc.lineno or 1,
+                        col=(exc.offset or 1), rule="parse-error",
+                        code="GLT000", severity=Severity.ERROR,
+                        message=f"cannot parse: {exc.msg}")]
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(module))
+    if suppress:
+        findings = apply_suppressions(findings,
+                                      Suppressions.from_source(source))
+    return findings
+
+
+def analyze_paths(paths: Iterable[str],
+                  rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as exc:
+            findings.append(Finding(
+                path=path, line=1, col=1, rule="io-error", code="GLT000",
+                severity=Severity.ERROR, message=str(exc)))
+            continue
+        findings.extend(analyze_source(source, path, rules))
+    return findings
+
+
+def _select_rules(select: Optional[str], ignore: Optional[str]
+                  ) -> List[Rule]:
+    by_key = {}
+    for cls in RULES.values():
+        rule = cls()
+        by_key[rule.name] = rule
+        by_key[rule.code.lower()] = rule
+    def lookup(spec: str) -> List[Rule]:
+        out = []
+        for key in spec.split(","):
+            key = key.strip().lower()
+            if not key:
+                continue
+            if key not in by_key:
+                raise SystemExit(f"gltlint: unknown rule {key!r} "
+                                 f"(see --list-rules)")
+            out.append(by_key[key])
+        return out
+    rules = lookup(select) if select else all_rules()
+    if ignore:
+        dropped = {r.name for r in lookup(ignore)}
+        rules = [r for r in rules if r.name not in dropped]
+    return rules
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m glt_tpu.analysis",
+        description="gltlint: TPU/JAX-aware static analysis for glt_tpu")
+    parser.add_argument("paths", nargs="*", default=["glt_tpu"],
+                        help="files or directories to analyze "
+                             "(default: glt_tpu)")
+    parser.add_argument("--select", metavar="RULES",
+                        help="comma-separated rule names/codes to run")
+    parser.add_argument("--ignore", metavar="RULES",
+                        help="comma-separated rule names/codes to skip")
+    parser.add_argument("--strict", action="store_true",
+                        help="treat warnings as errors for the exit code")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule registry and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.code}  {rule.name:32s} {rule.severity!s:8s} "
+                  f"{rule.description}")
+        return 0
+
+    rules = _select_rules(args.select, args.ignore)
+    findings = analyze_paths(args.paths, rules)
+    print(format_report(findings))
+    gate = (findings if args.strict else
+            [f for f in findings if f.severity is Severity.ERROR])
+    return 1 if gate else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

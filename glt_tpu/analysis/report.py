@@ -1,0 +1,109 @@
+"""Findings, severities, suppression parsing and report formatting.
+
+A finding is one (rule, location, message) triple.  Suppression is comment
+driven, pylint style but namespaced to this tool so the two never collide:
+
+* ``# gltlint: disable=rule-a,rule-b`` on the offending line silences those
+  rules for that line only;
+* ``# gltlint: disable-next=rule-a`` on the line above silences the line
+  below (for lines whose trailing comment space is already spoken for);
+* ``# gltlint: disable-file=rule-a`` anywhere in the file silences the rule
+  for the whole file;
+* the rule list may use rule names (``host-sync-in-jit``) or codes
+  (``GLT001``), and ``all`` matches every rule.
+
+Suppressions should carry a justification comment — the CI gate treats a
+bare suppression the same as a justified one, but reviewers should not.
+"""
+from __future__ import annotations
+
+import enum
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Set, Tuple
+
+
+class Severity(enum.IntEnum):
+    """Per-rule severity; only ERROR findings fail the CI gate."""
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self) -> str:  # "error" / "warning" in reports
+        return self.name.lower()
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic emitted by a rule."""
+    path: str
+    line: int
+    col: int
+    rule: str          # rule name, e.g. "host-sync-in-jit"
+    code: str          # rule code, e.g. "GLT001"
+    severity: Severity
+    message: str
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{str(self.severity).upper()} {self.code} "
+                f"[{self.rule}] {self.message}")
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*gltlint:\s*(disable|disable-next|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+@dataclass
+class Suppressions:
+    """Per-file suppression table parsed from comments."""
+    by_line: Dict[int, Set[str]] = field(default_factory=dict)
+    whole_file: Set[str] = field(default_factory=set)
+
+    @classmethod
+    def from_source(cls, source: str) -> "Suppressions":
+        sup = cls()
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            comments: List[Tuple[int, str]] = [
+                (tok.start[0], tok.string) for tok in tokens
+                if tok.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return sup
+        for line, text in comments:
+            for m in _SUPPRESS_RE.finditer(text):
+                kind = m.group(1)
+                rules = {r.strip().lower()
+                         for r in m.group(2).split(",") if r.strip()}
+                if kind == "disable-file":
+                    sup.whole_file |= rules
+                elif kind == "disable-next":
+                    sup.by_line.setdefault(line + 1, set()).update(rules)
+                else:
+                    sup.by_line.setdefault(line, set()).update(rules)
+        return sup
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        keys = {"all", finding.rule.lower(), finding.code.lower()}
+        if keys & self.whole_file:
+            return True
+        return bool(keys & self.by_line.get(finding.line, set()))
+
+
+def apply_suppressions(findings: Iterable[Finding],
+                       suppressions: Suppressions) -> List[Finding]:
+    return [f for f in findings if not suppressions.is_suppressed(f)]
+
+
+def format_report(findings: List[Finding]) -> str:
+    """Human-readable report: findings sorted by location + a summary."""
+    lines = [f.format() for f in
+             sorted(findings, key=lambda f: (f.path, f.line, f.col))]
+    n_err = sum(1 for f in findings if f.severity is Severity.ERROR)
+    n_warn = len(findings) - n_err
+    if findings:
+        lines.append("")
+    lines.append(f"gltlint: {n_err} error(s), {n_warn} warning(s)")
+    return "\n".join(lines)

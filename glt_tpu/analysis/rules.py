@@ -1,0 +1,840 @@
+"""gltlint rules: the six TPU/JAX hazards this engine actually hits.
+
+Each rule is a class with a ``check(module: ModuleInfo) -> [Finding]``
+method, registered in ``RULES`` by name.  Severities: ERROR findings gate
+CI (non-zero exit), WARNINGs report but pass.
+
+The analyses are deliberately linear/flow-light: statements are walked in
+source order, ``if`` branches fork analysis state, loops are traversed
+once.  That trades soundness for a near-zero false-positive rate on this
+codebase — every rule here was calibrated by running it over ``glt_tpu``
+and inspecting each hit.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .report import Finding, Severity
+from .visitor import (
+    JIT_NAMES,
+    FunctionScope,
+    ModuleInfo,
+    assign_targets,
+    names_loaded,
+    param_names,
+)
+
+RULES: Dict[str, type] = {}
+
+
+def register(cls):
+    RULES[cls.name] = cls
+    return cls
+
+
+class Rule:
+    """Base rule; subclasses set name/code/severity/description."""
+    name: str = ""
+    code: str = ""
+    severity: Severity = Severity.ERROR
+    description: str = ""
+
+    def finding(self, module: ModuleInfo, node: ast.AST, message: str
+                ) -> Finding:
+        return Finding(path=module.path, line=node.lineno,
+                       col=node.col_offset + 1, rule=self.name,
+                       code=self.code, severity=self.severity,
+                       message=message)
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        raise NotImplementedError
+
+
+def _walk_own(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk an AST without descending into nested function/class bodies
+    (those are separate scopes with their own analysis passes)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if not isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(cur))
+
+
+def _dotted(node: ast.expr) -> Optional[str]:
+    """'self.x.y' style dotted string for Name/Attribute chains (no alias
+    resolution — used for tracking local/attribute variables)."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if not isinstance(cur, ast.Name):
+        return None
+    parts.append(cur.id)
+    return ".".join(reversed(parts))
+
+
+def _expr_names(node: ast.AST) -> Set[str]:
+    """Names + self-attribute dotted strings read inside ``node``."""
+    out = names_loaded(node)
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute):
+            d = _dotted(n)
+            if d is not None:
+                out.add(d)
+    return out
+
+
+_STATIC_ATTRS = ("shape", "ndim", "dtype", "size")
+
+
+def _traced_names(node: ast.AST) -> Set[str]:
+    """Like :func:`_expr_names`, but a name reached only through a static
+    attribute (``x.shape[0]`` — a Python int even on a tracer) does not
+    count as a traced-value read."""
+    out: Set[str] = set()
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, ast.Attribute) and cur.attr in _STATIC_ATTRS:
+            continue                       # x.shape / x.ndim: static
+        if isinstance(cur, ast.Name) and isinstance(cur.ctx, ast.Load):
+            out.add(cur.id)
+        if isinstance(cur, ast.Attribute):
+            d = _dotted(cur)
+            if d is not None:
+                out.add(d)
+        stack.extend(ast.iter_child_nodes(cur))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GLT001 host-sync-in-jit
+# ---------------------------------------------------------------------------
+
+@register
+class HostSyncInJit(Rule):
+    """Host transfers/synchronisation on traced values inside jit.
+
+    ``np.asarray``/``np.array``/``jax.device_get``/``.item()``/``int()``/
+    ``float()``/``bool()`` on a traced value either fails at trace time
+    (TracerArrayConversionError) or — worse, via callbacks — inserts a
+    device->host sync into the sampling hot path, serialising the TPU
+    against the host exactly as BGL measured for GNN data pipelines.
+    """
+    name = "host-sync-in-jit"
+    code = "GLT001"
+    severity = Severity.ERROR
+    description = ("numpy conversion / Python scalar coercion of a traced "
+                   "value inside a jit/shard_map context")
+
+    HOST_CALLS = {
+        "numpy.asarray", "numpy.array", "numpy.copy", "numpy.frombuffer",
+        "numpy.ascontiguousarray", "jax.device_get",
+    }
+    COERCIONS = {"int", "float", "bool", "complex"}
+    SYNC_METHODS = {"item", "tolist", "to_py", "block_until_ready"}
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        taint_by_scope: Dict[FunctionScope, Set[str]] = {}
+        # Fixpoint so transitively-jitted helpers see their caller's taint
+        # (their params are traced only if the call site passes traced
+        # values — static sizing helpers called with Python config stay
+        # clean).
+        for _ in range(4):
+            changed = False
+            for scope in module.scopes:   # DFS order: parents first
+                if not module.in_jit_context(scope):
+                    continue
+                taint = self._seed_taint(module, scope, taint_by_scope)
+                if scope.parent in taint_by_scope:
+                    taint |= taint_by_scope[scope.parent]
+                # two linear passes propagate taint through assignments
+                for _ in range(2):
+                    for node in _walk_own(scope.node):
+                        if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                             ast.AugAssign)):
+                            value = node.value
+                            if value is not None and (_traced_names(value)
+                                                      & taint):
+                                taint |= set(assign_targets(node))
+                if taint_by_scope.get(scope) != taint:
+                    taint_by_scope[scope] = taint
+                    changed = True
+            if not changed:
+                break
+        for scope in module.scopes:
+            if not module.in_jit_context(scope):
+                continue
+            taint = taint_by_scope.get(scope, set())
+            for node in _walk_own(scope.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                findings.extend(self._check_call(module, scope, node, taint))
+        return findings
+
+    def _seed_taint(self, module: ModuleInfo, scope: FunctionScope,
+                    taint_by_scope: Dict[FunctionScope, Set[str]]
+                    ) -> Set[str]:
+        """Initial traced-value set: all params for direct jit roots, only
+        traced-at-the-call-site params for transitive ones."""
+        if scope.transitive_call is None:
+            # `self`/`cls` are bound (or closure-captured) at jit time,
+            # never traced — counting them floods attribute reads.
+            return set(scope.params) - scope.static_args - {"self", "cls"}
+        caller, call = scope.transitive_call
+        caller_taint = taint_by_scope.get(caller, set())
+        params = scope.params
+        # bound method call (self.f(...)): positional args bind past self
+        if params[:1] == ["self"] and isinstance(call.func, ast.Attribute):
+            pos = params[1:]
+        else:
+            pos = params
+        seed: Set[str] = set()
+        for i, arg in enumerate(call.args):
+            if i < len(pos) and (_traced_names(arg) & caller_taint):
+                seed.add(pos[i])
+        for kw in call.keywords:
+            if kw.arg in params and (_traced_names(kw.value) & caller_taint):
+                seed.add(kw.arg)
+        return seed - scope.static_args
+
+    def _check_call(self, module: ModuleInfo, scope: FunctionScope,
+                    call: ast.Call, taint: Set[str]) -> List[Finding]:
+        name = module.call_name(call)
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        touched = set().union(*[_traced_names(a) for a in args]) if args else set()
+        where = (f"in jit context '{scope.name}' ({scope.jit_reason})"
+                 if scope.jit_reason else f"in jit context '{scope.name}'")
+        if name in self.HOST_CALLS and (touched & taint):
+            var = sorted(touched & taint)[0]
+            return [self.finding(
+                module, call,
+                f"{name}() on traced value '{var}' {where}: forces a "
+                f"device->host transfer (or TracerArrayConversionError); "
+                f"use jnp/lax ops instead")]
+        if name in self.COERCIONS and (touched & taint):
+            var = sorted(touched & taint)[0]
+            return [self.finding(
+                module, call,
+                f"{name}() on traced value '{var}' {where}: concretises "
+                f"the tracer (ConcretizationTypeError at trace time); "
+                f"hoist to host code or keep it an array")]
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in self.SYNC_METHODS
+                and (_traced_names(call.func.value) & taint)):
+            var = sorted(_traced_names(call.func.value) & taint)[0]
+            return [self.finding(
+                module, call,
+                f".{call.func.attr}() on traced value '{var}' {where}: "
+                f"host sync point inside the compiled program")]
+        return []
+
+
+# ---------------------------------------------------------------------------
+# GLT002 prng-key-reuse
+# ---------------------------------------------------------------------------
+
+_KEY_SOURCES = {
+    "jax.random.PRNGKey", "jax.random.key", "jax.random.split",
+    "jax.random.fold_in", "jax.random.clone", "jax.random.wrap_key_data",
+}
+# Deriving fresh keys from a base key is the sanctioned way to reuse it.
+_NON_CONSUMING = {"jax.random.split", "jax.random.fold_in",
+                  "jax.random.clone", "jax.random.key_data"}
+_KEY_PARAM_HINTS = ("key", "rng", "prng")
+
+
+def _looks_like_key_param(name: str) -> bool:
+    low = name.lower()
+    return (low in ("key", "rng", "prngkey", "prng_key", "base_key")
+            or low.endswith("_key") or low.endswith("_rng")
+            or low.endswith("_keys"))
+
+
+@register
+class PrngKeyReuse(Rule):
+    """The same PRNG key consumed by two sampling calls.
+
+    jax.random is counter-based: passing one key to two draws yields
+    *identical* randomness — on the sampler hot path that silently
+    correlates hops/batches (every neighbor draw repeats).  A key may be
+    consumed once; reuse requires an intervening ``split``/``fold_in``.
+    """
+    name = "prng-key-reuse"
+    code = "GLT002"
+    severity = Severity.ERROR
+    description = ("a PRNG key passed to two consuming calls without an "
+                   "intervening jax.random.split/fold_in")
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        for scope in module.scopes:
+            if isinstance(scope.node, ast.Lambda):
+                continue
+            state: Dict[str, int] = {
+                p: 0 for p in scope.params if _looks_like_key_param(p)}
+            self._run(module, scope.node.body, state, findings)
+        return findings
+
+    # -- branch-aware linear interpreter ----------------------------------
+    def _run(self, module: ModuleInfo, body: Sequence[ast.stmt],
+             state: Dict[str, int], findings: List[Finding]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                s1, s2 = dict(state), dict(state)
+                self._run(module, stmt.body, s1, findings)
+                self._run(module, stmt.orelse, s2, findings)
+                # conservative merge: a use must happen on *every* path to
+                # count against later statements
+                state.clear()
+                for var in set(s1) & set(s2):
+                    state[var] = min(s1[var], s2[var])
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                self._visit_exprs(module, stmt, state, findings,
+                                  skip_body=True)
+                self._run(module, stmt.body, state, findings)
+                self._run(module, stmt.orelse, state, findings)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._run(module, stmt.body, state, findings)
+                for h in stmt.handlers:
+                    self._run(module, h.body, dict(state), findings)
+                self._run(module, stmt.orelse, state, findings)
+                self._run(module, stmt.finalbody, state, findings)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._visit_exprs(module, stmt, state, findings,
+                                  skip_body=True)
+                self._run(module, stmt.body, state, findings)
+                continue
+            self._visit_exprs(module, stmt, state, findings)
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                self._apply_assign(module, stmt, state)
+
+    def _visit_exprs(self, module: ModuleInfo, stmt: ast.stmt,
+                     state: Dict[str, int], findings: List[Finding],
+                     skip_body: bool = False) -> None:
+        nodes: Iterator[ast.AST]
+        if skip_body:
+            nodes = iter(())
+            for field in ("test", "iter", "items", "target"):
+                sub = getattr(stmt, field, None)
+                if sub is not None:
+                    sub_list = sub if isinstance(sub, list) else [sub]
+                    nodes = iter(list(nodes) + [
+                        n for s in sub_list
+                        for n in ast.walk(s if not hasattr(s, "context_expr")
+                                          else s.context_expr)])
+        else:
+            nodes = _walk_own(stmt)
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            name = module.call_name(node)
+            if name in _NON_CONSUMING:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in state:
+                    state[arg.id] += 1
+                    if state[arg.id] == 2:
+                        findings.append(self.finding(
+                            module, node,
+                            f"PRNG key '{arg.id}' consumed a second time "
+                            f"(same randomness as its first use); derive a "
+                            f"fresh key with jax.random.split/fold_in "
+                            f"before this call"))
+
+    def _apply_assign(self, module: ModuleInfo, stmt: ast.stmt,
+                      state: Dict[str, int]) -> None:
+        targets = assign_targets(stmt)
+        value = getattr(stmt, "value", None)
+        is_key_src = (isinstance(value, ast.Call)
+                      and module.call_name(value) in _KEY_SOURCES)
+        for t in targets:
+            if is_key_src:
+                state[t] = 0            # fresh key: uses reset
+            elif t in state:
+                del state[t]            # overwritten with a non-key value
+
+
+# ---------------------------------------------------------------------------
+# GLT003 recompile-hazard
+# ---------------------------------------------------------------------------
+
+@register
+class RecompileHazard(Rule):
+    """Python scalars closure-captured into a jit target.
+
+    ``jax.jit(lambda x: x * n)`` bakes ``n`` into the traced program as a
+    compile-time constant: every distinct value of ``n`` (a batch width, a
+    ``.shape[0]``, a fanout) triggers a full recompile — the PyGraph
+    failure mode, silent on TPU until the profile shows nothing but
+    compilation.  Pass the scalar as a (possibly static) argument instead.
+    """
+    name = "recompile-hazard"
+    code = "GLT003"
+    severity = Severity.WARNING
+    description = ("a Python scalar captured by a jitted closure without "
+                   "static_argnums/static_argnames")
+
+    _SCALAR_CALLS = {"int", "float", "len", "round", "min", "max"}
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        for scope in module.scopes:
+            if isinstance(scope.node, ast.Lambda):
+                continue
+            scalars = self._scalar_locals(module, scope)
+            if not scalars:
+                continue
+            for node in _walk_own(scope.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if module.call_name(node) not in JIT_NAMES:
+                    continue
+                findings.extend(
+                    self._check_jit_call(module, scope, node, scalars))
+        return findings
+
+    def _scalar_locals(self, module: ModuleInfo, scope: FunctionScope
+                       ) -> Set[str]:
+        """Locals assigned from obviously-Python-scalar expressions."""
+        scalars: Set[str] = set()
+        for node in _walk_own(scope.node):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            if node.value is not None and self._is_scalarish(module,
+                                                             node.value):
+                scalars |= set(assign_targets(node))
+        return scalars
+
+    def _is_scalarish(self, module: ModuleInfo, expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Constant):
+            return isinstance(expr.value, (int, float)) and not isinstance(
+                expr.value, bool)
+        if isinstance(expr, ast.Call):
+            return module.call_name(expr) in self._SCALAR_CALLS
+        if isinstance(expr, ast.Attribute):
+            return expr.attr in ("shape", "ndim", "size")
+        if isinstance(expr, ast.Subscript):
+            return (isinstance(expr.value, ast.Attribute)
+                    and expr.value.attr == "shape")
+        if isinstance(expr, ast.BinOp):
+            return (self._is_scalarish(module, expr.left)
+                    or self._is_scalarish(module, expr.right))
+        return False
+
+    def _check_jit_call(self, module: ModuleInfo, scope: FunctionScope,
+                        call: ast.Call, scalars: Set[str]) -> List[Finding]:
+        has_static = any(kw.arg in ("static_argnums", "static_argnames")
+                         for kw in call.keywords)
+        if has_static or not call.args:
+            return []
+        target = call.args[0]
+        fn_node = None
+        if isinstance(target, ast.Lambda):
+            fn_node = target
+        elif isinstance(target, ast.Name):
+            for child in module.scopes:
+                if (child.parent is scope and child.name == target.id
+                        and not isinstance(child.node, ast.Lambda)):
+                    fn_node = child.node
+                    break
+        if fn_node is None:
+            return []
+        body = (fn_node.body if isinstance(fn_node, ast.Lambda)
+                else fn_node)
+        free = names_loaded(body) - set(param_names(fn_node))
+        if not isinstance(fn_node, ast.Lambda):
+            for node in _walk_own(fn_node):
+                if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign)):
+                    free -= set(assign_targets(node))
+        captured = sorted(free & scalars)
+        if not captured:
+            return []
+        return [self.finding(
+            module, call,
+            f"jit target closes over Python scalar(s) "
+            f"{', '.join(repr(c) for c in captured)}: each distinct value "
+            f"recompiles the program; pass as an argument (traced) or mark "
+            f"static_argnums/static_argnames")]
+
+
+# ---------------------------------------------------------------------------
+# GLT004 int64-id-truncation
+# ---------------------------------------------------------------------------
+
+@register
+class Int64IdTruncation(Rule):
+    """int64 node/edge ids fed to jnp without an explicit dtype.
+
+    JAX disables x64 by default: ``jnp.asarray(ids_int64)`` silently
+    truncates to int32.  Ids above 2**31 (papers100M edge ids already
+    qualify) wrap negative and index garbage rows.  Either pass an
+    explicit dtype (acknowledging the narrowing) or relabel ids into
+    int32 range first.
+    """
+    name = "int64-id-truncation"
+    code = "GLT004"
+    severity = Severity.ERROR
+    description = ("np.int64 values flowing into jnp.asarray/array with no "
+                   "explicit dtype (silent int32 truncation under default "
+                   "x64-disabled JAX)")
+
+    _SINKS = {"jax.numpy.asarray", "jax.numpy.array"}
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        module_taint = self._collect_taint(module, module.tree, set())
+        self._scan(module, module.tree, module_taint, findings,
+                   skip_scopes=True)
+        for scope in module.scopes:
+            taint = self._collect_taint(module, scope.node,
+                                        set(module_taint))
+            self._scan(module, scope.node, taint, findings,
+                       skip_scopes=False)
+        return findings
+
+    def _collect_taint(self, module: ModuleInfo, root: ast.AST,
+                       seed: Set[str]) -> Set[str]:
+        taint = set(seed)
+        for _ in range(2):
+            for node in _walk_own(root):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                if node.value is None:
+                    continue
+                if (self._is_int64_expr(module, node.value)
+                        or self._propagates(module, node.value, taint)):
+                    for t in assign_targets(node):
+                        taint.add(t)
+                    # also self.x targets
+                    tgts = (node.targets if isinstance(node, ast.Assign)
+                            else [node.target])
+                    for t in tgts:
+                        d = _dotted(t)
+                        if d is not None and "." in d:
+                            taint.add(d)
+        return taint
+
+    def _propagates(self, module: ModuleInfo, expr: ast.expr,
+                    taint: Set[str]) -> bool:
+        """Does int64-ness flow from a tainted name into this value?
+
+        Structural operations (copies, indexing, arithmetic, ``np.*``
+        reshuffles, ``.reshape()``-style methods on tainted values) keep
+        the dtype; results of arbitrary user functions do not inherit it
+        — assuming they did floods every consumer of an id array.
+        Comparisons/boolean ops yield bools, never ids.
+        """
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            d = _dotted(expr)
+            return d in taint if d is not None else False
+        if isinstance(expr, ast.Subscript):
+            return self._propagates(module, expr.value, taint)
+        if isinstance(expr, ast.BinOp):
+            return (self._propagates(module, expr.left, taint)
+                    or self._propagates(module, expr.right, taint))
+        if isinstance(expr, ast.UnaryOp):
+            return self._propagates(module, expr.operand, taint)
+        if isinstance(expr, ast.IfExp):
+            return (self._propagates(module, expr.body, taint)
+                    or self._propagates(module, expr.orelse, taint))
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self._propagates(module, el, taint)
+                       for el in expr.elts)
+        if isinstance(expr, ast.Starred):
+            return self._propagates(module, expr.value, taint)
+        if isinstance(expr, ast.Call):
+            name = module.call_name(expr) or ""
+            args = list(expr.args) + [kw.value for kw in expr.keywords]
+            any_tainted = any(self._propagates(module, a, taint)
+                              for a in args)
+            if name.startswith("numpy.") and not name.startswith(
+                    "numpy.random."):
+                return any_tainted
+            # dtype-preserving method on a tainted value: x.reshape(...)
+            if (isinstance(expr.func, ast.Attribute)
+                    and expr.func.attr in ("reshape", "ravel", "copy",
+                                           "flatten", "squeeze",
+                                           "transpose", "take", "clip")
+                    and self._propagates(module, expr.func.value, taint)):
+                return True
+            return False
+        return False
+
+    def _is_int64_expr(self, module: ModuleInfo, expr: ast.expr) -> bool:
+        """Does the expression *introduce* int64-ness?"""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Attribute):
+                if module.imports.resolve(node) in ("numpy.int64",
+                                                    "numpy.uint64"):
+                    return True
+            if isinstance(node, ast.Call):
+                # .astype(np.int64) / .astype("int64")
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "astype" and node.args):
+                    a = node.args[0]
+                    if (module.imports.resolve(a) in ("numpy.int64",
+                                                      "numpy.uint64")
+                            or (isinstance(a, ast.Constant)
+                                and a.value in ("int64", "uint64"))):
+                        return True
+                # np.*(..., dtype=np.int64)
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and (
+                            module.imports.resolve(kw.value)
+                            in ("numpy.int64", "numpy.uint64")
+                            or (isinstance(kw.value, ast.Constant)
+                                and kw.value.value in ("int64", "uint64"))):
+                        return True
+        return False
+
+    def _scan(self, module: ModuleInfo, root: ast.AST, taint: Set[str],
+              findings: List[Finding], skip_scopes: bool) -> None:
+        walker = (_walk_own(root) if skip_scopes else ast.walk(root))
+        for node in walker:
+            if not isinstance(node, ast.Call):
+                continue
+            if module.call_name(node) not in self._SINKS:
+                continue
+            if len(node.args) >= 2:            # positional dtype
+                continue
+            if any(kw.arg == "dtype" for kw in node.keywords):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            hit = self._is_int64_expr(module, arg)
+            tainted = (sorted(_expr_names(arg) & taint)
+                       if self._propagates(module, arg, taint) else [])
+            if hit or tainted:
+                what = (f"'{tainted[0]}'" if tainted
+                        else "an int64 expression")
+                findings.append(self.finding(
+                    module, node,
+                    f"jnp conversion of int64 ids ({what}) without an "
+                    f"explicit dtype: silently truncates to int32 under "
+                    f"default x64-disabled JAX; pass dtype= (or relabel "
+                    f"into int32 range first)"))
+
+
+# ---------------------------------------------------------------------------
+# GLT005 nondeterministic-default-rng
+# ---------------------------------------------------------------------------
+
+@register
+class NondeterministicDefaultRng(Rule):
+    """Unseeded ``np.random.default_rng()`` in library code.
+
+    OS-entropy seeding makes sampling unreproducible across runs and —
+    worse on a pod — *divergent across hosts*, so "identical" per-host
+    programs sample different subgraphs and collective shapes drift.
+    Always seed from configuration (and fold in the epoch/host index).
+    """
+    name = "nondeterministic-default-rng"
+    code = "GLT005"
+    severity = Severity.WARNING
+    description = "np.random.default_rng() with no seed argument"
+
+    _RNG = {"numpy.random.default_rng", "numpy.random.Generator",
+            "numpy.random.RandomState"}
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        # fresh-generator-inline-draw: default_rng(seed).permutation(x)
+        # where `seed` is a parameter of the enclosing function replays
+        # the identical stream on every call — the repeated-permutation-
+        # across-epochs bug class (a constant literal seed is a one-shot
+        # deterministic fixture; a per-call-varying seed expression is a
+        # deliberate stream; a bare parameter is the same value every
+        # call of this function).
+        for scope in module.scopes:
+            params = set(scope.params)
+            for node in _walk_own(scope.node):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Call)
+                        and module.call_name(node.func.value) in self._RNG
+                        and node.func.value.args):
+                    continue
+                seed_arg = node.func.value.args[0]
+                if (isinstance(seed_arg, ast.Name)
+                        and seed_arg.id in params):
+                    findings.append(self.finding(
+                        module, node,
+                        f"fresh Generator from parameter "
+                        f"'{seed_arg.id}' drawn inline "
+                        f"(.{node.func.attr}()): every call of "
+                        f"'{scope.name}' replays the identical stream — "
+                        f"across epochs that repeats the exact "
+                        f"permutation; thread a stateful Generator "
+                        f"through instead"))
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = module.call_name(node)
+            if name not in self._RNG:
+                continue
+            unseeded = not node.args and not node.keywords
+            if not unseeded and node.args:
+                a = node.args[0]
+                unseeded = isinstance(a, ast.Constant) and a.value is None
+            if unseeded:
+                findings.append(self.finding(
+                    module, node,
+                    f"{name}() without a seed: draws from OS entropy — "
+                    f"unreproducible, and divergent across pod hosts; "
+                    f"thread a seeded Generator through instead"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# GLT006 shadowed-jit-donation
+# ---------------------------------------------------------------------------
+
+@register
+class ShadowedJitDonation(Rule):
+    """A buffer read again after being donated to a jitted call.
+
+    ``donate_argnums`` hands the argument's buffer to XLA for reuse; the
+    original array is *deleted*.  A later read raises
+    RuntimeError("Array has been deleted") on TPU — but passes silently
+    on CPU backends where donation is a no-op, so only the lint (or the
+    pod) catches it.
+    """
+    name = "shadowed-jit-donation"
+    code = "GLT006"
+    severity = Severity.ERROR
+    description = ("an array used again after being passed through "
+                   "donate_argnums")
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        donors = self._collect_donors(module)
+        if not donors:
+            return []
+        findings: List[Finding] = []
+        for scope in module.scopes:
+            if isinstance(scope.node, ast.Lambda):
+                continue
+            self._run(module, scope.node.body, donors, {}, findings)
+        self._run(module, module.tree.body, donors, {}, findings)
+        return findings
+
+    def _collect_donors(self, module: ModuleInfo) -> Dict[str, Set[int]]:
+        """callable name -> donated positional indices (module-wide)."""
+        donors: Dict[str, Set[int]] = {}
+        for scope in module.scopes:
+            if scope.donate_argnums:
+                donors[scope.name] = set(scope.donate_argnums)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = getattr(node, "value", None)
+            if not (isinstance(value, ast.Call)
+                    and module.call_name(value) in JIT_NAMES):
+                continue
+            donated = {el for kw in value.keywords
+                       if kw.arg == "donate_argnums"
+                       for el in _iter_const_ints(kw.value)}
+            if not donated:
+                continue
+            tgts = (node.targets if isinstance(node, ast.Assign)
+                    else [node.target])
+            for t in tgts:
+                d = _dotted(t)
+                if d is not None:
+                    donors[d] = set(donated)
+        return donors
+
+    def _run(self, module: ModuleInfo, body: Sequence[ast.stmt],
+             donors: Dict[str, Set[int]],
+             dead: Dict[str, Tuple[int, str]],
+             findings: List[Finding]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                s1, s2 = dict(dead), dict(dead)
+                self._run(module, stmt.body, donors, s1, findings)
+                self._run(module, stmt.orelse, donors, s2, findings)
+                dead.clear()
+                dead.update(s1)
+                dead.update(s2)      # dead on either path counts
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While,
+                                 ast.With, ast.AsyncWith, ast.Try)):
+                for sub in (getattr(stmt, "body", []) or []):
+                    self._run(module, [sub], donors, dead, findings)
+                for sub in (getattr(stmt, "orelse", []) or []):
+                    self._run(module, [sub], donors, dead, findings)
+                for h in getattr(stmt, "handlers", ()) or ():
+                    self._run(module, h.body, donors, dict(dead), findings)
+                for sub in (getattr(stmt, "finalbody", []) or []):
+                    self._run(module, [sub], donors, dead, findings)
+                continue
+            # 1) reads of already-donated buffers (before this statement's
+            #    own donation processing)
+            donating_calls = [n for n in _walk_own(stmt)
+                              if isinstance(n, ast.Call)
+                              and self._donor_name(n, donors) is not None]
+            donated_arg_nodes: Set[int] = set()
+            for call in donating_calls:
+                name = self._donor_name(call, donors)
+                for idx in donors[name]:
+                    if idx < len(call.args) and isinstance(call.args[idx],
+                                                           ast.Name):
+                        donated_arg_nodes.add(id(call.args[idx]))
+            for node in _walk_own(stmt):
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in dead
+                        and id(node) not in donated_arg_nodes):
+                    line, fn = dead[node.id]
+                    findings.append(self.finding(
+                        module, node,
+                        f"'{node.id}' used after being donated to "
+                        f"'{fn}' (line {line}): donated buffers are "
+                        f"deleted on TPU (RuntimeError); copy first or "
+                        f"drop the reuse"))
+                    del dead[node.id]          # report once per donation
+            # 2) this statement's donations
+            for call in donating_calls:
+                name = self._donor_name(call, donors)
+                for idx in donors[name]:
+                    if idx < len(call.args) and isinstance(call.args[idx],
+                                                           ast.Name):
+                        dead[call.args[idx].id] = (call.lineno, name)
+            # 3) reassignments resurrect
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                for t in assign_targets(stmt):
+                    dead.pop(t, None)
+
+    @staticmethod
+    def _donor_name(call: ast.Call, donors: Dict[str, Set[int]]
+                    ) -> Optional[str]:
+        d = _dotted(call.func)
+        return d if d in donors else None
+
+
+def _iter_const_ints(node: ast.expr) -> Iterator[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for el in node.elts:
+            yield from _iter_const_ints(el)
+
+
+def all_rules() -> List[Rule]:
+    return [cls() for cls in RULES.values()]

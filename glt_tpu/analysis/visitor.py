@@ -1,0 +1,357 @@
+"""Shared AST machinery: import resolution, scopes, jit-context discovery.
+
+Every rule needs the same three questions answered about a module:
+
+1. *What does this name really mean?*  ``jnp.asarray`` vs
+   ``jax.numpy.asarray`` vs ``from jax.numpy import asarray`` are one
+   callee.  :class:`ImportMap` canonicalises call targets to full dotted
+   paths ("jax.numpy.asarray", "numpy.random.default_rng", ...).
+
+2. *Which code is traced?*  ``@jax.jit`` / ``@partial(jax.jit, ...)``
+   decorators, ``jax.jit(fn)`` / ``jax.jit(jax.shard_map(fn, ...))``
+   wrapping expressions (including ``jax.jit(self._impl)`` on methods),
+   and module-level helpers called from traced bodies are all jit
+   contexts; host-side rules must not fire there and trace-side rules
+   only fire there.
+
+3. *What is the statement order inside a function?*  Key-reuse and
+   donation analyses walk statements linearly, forking state at ``if``
+   branches (a use in the else-branch is not "after" a use in the
+   then-branch).
+
+This module answers 1 and 2 (:class:`ModuleInfo`); rules implement 3 on
+top with :func:`iter_statements` / :func:`names_loaded` helpers.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple, Union
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+# Canonical dotted names (post alias-resolution) for the JAX tracing
+# entry points.  ``pjit``/``shard_map`` trace exactly like ``jit``.
+JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+SHARD_MAP_NAMES = {
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.experimental.maps.xmap",
+}
+PARTIAL_NAMES = {"functools.partial", "partial"}
+TRACE_WRAPPERS = JIT_NAMES | SHARD_MAP_NAMES | {
+    "jax.vmap", "jax.pmap", "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat", "jax.lax.scan", "jax.lax.map",
+}
+
+
+class ImportMap:
+    """Alias table mapping local names to canonical dotted module paths."""
+
+    def __init__(self) -> None:
+        self._alias: Dict[str, str] = {}
+
+    def collect(self, tree: ast.AST) -> "ImportMap":
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self._alias[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self._alias[a.asname or a.name] = (
+                        f"{node.module}.{a.name}")
+        return self
+
+    def resolve(self, node: ast.expr) -> Optional[str]:
+        """Canonical dotted path for a Name/Attribute chain, else None.
+
+        Unaliased bare names resolve to themselves so builtins (``int``,
+        ``float``) and locals still produce a comparable string.
+        """
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        root = self._alias.get(cur.id, cur.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+
+def names_loaded(node: ast.AST) -> Set[str]:
+    """All Name identifiers read anywhere inside ``node``."""
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def param_names(fn: FunctionNode) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def assign_targets(node: ast.stmt) -> List[str]:
+    """Plain-Name targets (including tuple unpacking) of an assignment."""
+    out: List[str] = []
+    targets: Sequence[ast.expr] = ()
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = (node.target,)
+    for t in targets:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                if isinstance(el, ast.Name):
+                    out.append(el.id)
+                elif isinstance(el, ast.Starred) and isinstance(
+                        el.value, ast.Name):
+                    out.append(el.value.id)
+    return out
+
+
+def iter_statements(body: Sequence[ast.stmt]) -> Iterator[ast.stmt]:
+    """Flatten statements in source order, descending into compound
+    statements (but NOT into nested function/class definitions)."""
+    for stmt in body:
+        yield stmt
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if sub and not isinstance(stmt, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef,
+                                             ast.ClassDef)):
+                yield from iter_statements(sub)
+        for handler in getattr(stmt, "handlers", ()) or ():
+            yield from iter_statements(handler.body)
+
+
+@dataclass(eq=False)     # identity hash: scopes key analysis caches
+class FunctionScope:
+    node: FunctionNode
+    name: str
+    parent: Optional["FunctionScope"]     # enclosing function, if nested
+    class_name: Optional[str]             # enclosing class, if a method
+    jit_root: bool = False                # directly jitted/shard_mapped
+    jit_reason: str = ""                  # how it became a jit context
+    static_args: Set[str] = field(default_factory=set)
+    donate_argnums: Set[int] = field(default_factory=set)
+    # set when jit-ness is only transitive (called from a jit body):
+    # (caller scope, call node) — rules use it to bind caller taint to
+    # params instead of assuming every param is traced
+    transitive_call: Optional[Tuple["FunctionScope", ast.Call]] = None
+
+    @property
+    def params(self) -> List[str]:
+        return param_names(self.node)
+
+
+def _unwrap_traced_target(call: ast.Call, imports: ImportMap
+                          ) -> Optional[ast.expr]:
+    """Peel ``jax.jit(jax.shard_map(partial(fn, ...), ...))`` down to the
+    innermost traced callable expression (fn)."""
+    if not call.args:
+        return None
+    target = call.args[0]
+    while isinstance(target, ast.Call):
+        inner = imports.resolve(target.func)
+        if inner in TRACE_WRAPPERS or inner in PARTIAL_NAMES:
+            if not target.args:
+                return None
+            target = target.args[0]
+        else:
+            break
+    return target
+
+
+def _static_arg_names(call: ast.Call, fn: Optional[FunctionNode]
+                      ) -> Set[str]:
+    """Names covered by static_argnums/static_argnames in a jit call."""
+    static: Set[str] = set()
+    pos = param_names(fn) if fn is not None else []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for el in _iter_const(kw.value):
+                if isinstance(el, str):
+                    static.add(el)
+        elif kw.arg == "static_argnums":
+            for el in _iter_const(kw.value):
+                if isinstance(el, int) and 0 <= el < len(pos):
+                    static.add(pos[el])
+    return static
+
+
+def _donated_argnums(call: ast.Call) -> Set[int]:
+    out: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            out |= {el for el in _iter_const(kw.value)
+                    if isinstance(el, int)}
+    return out
+
+
+def _iter_const(node: ast.expr) -> Iterator[object]:
+    if isinstance(node, ast.Constant):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for el in node.elts:
+            yield from _iter_const(el)
+
+
+class ModuleInfo:
+    """Parsed module + resolved imports + jit-context classification."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.imports = ImportMap().collect(self.tree)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.scopes: List[FunctionScope] = []
+        self._scope_by_node: Dict[ast.AST, FunctionScope] = {}
+        self._collect_scopes(self.tree, None, None)
+        self._mark_jit_roots()
+        self._mark_called_from_jit()
+
+    # -- scope collection --------------------------------------------------
+    def _collect_scopes(self, node: ast.AST, parent: Optional[FunctionScope],
+                        class_name: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = FunctionScope(child, child.name, parent, class_name)
+                self.scopes.append(scope)
+                self._scope_by_node[child] = scope
+                self._collect_scopes(child, scope, None)
+            elif isinstance(child, ast.Lambda):
+                scope = FunctionScope(child, "<lambda>", parent, class_name)
+                self.scopes.append(scope)
+                self._scope_by_node[child] = scope
+                self._collect_scopes(child, scope, None)
+            elif isinstance(child, ast.ClassDef):
+                self._collect_scopes(child, parent, child.name)
+            else:
+                self._collect_scopes(child, parent, class_name)
+
+    # -- jit classification ------------------------------------------------
+    def _resolve(self, node: ast.expr) -> Optional[str]:
+        return self.imports.resolve(node)
+
+    def _mark_decorated(self, scope: FunctionScope) -> None:
+        fn = scope.node
+        if isinstance(fn, ast.Lambda):
+            return
+        for dec in fn.decorator_list:
+            name = self._resolve(dec if not isinstance(dec, ast.Call)
+                                 else dec.func)
+            if name in JIT_NAMES | SHARD_MAP_NAMES:
+                scope.jit_root = True
+                scope.jit_reason = f"decorated with {name}"
+                if isinstance(dec, ast.Call):
+                    scope.static_args |= _static_arg_names(dec, fn)
+                    scope.donate_argnums |= _donated_argnums(dec)
+            elif (isinstance(dec, ast.Call) and name in PARTIAL_NAMES
+                  and dec.args):
+                inner = self._resolve(dec.args[0])
+                if inner in JIT_NAMES | SHARD_MAP_NAMES:
+                    scope.jit_root = True
+                    scope.jit_reason = f"decorated with partial({inner})"
+                    scope.static_args |= _static_arg_names(dec, fn)
+                    scope.donate_argnums |= _donated_argnums(dec)
+
+    def _mark_jit_roots(self) -> None:
+        for scope in self.scopes:
+            self._mark_decorated(scope)
+        # jax.jit(expr) / jax.jit(jax.shard_map(expr, ...)) call sites.
+        by_name: Dict[str, List[FunctionScope]] = {}
+        for scope in self.scopes:
+            by_name.setdefault(scope.name, []).append(scope)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self._resolve(node.func)
+            if name not in JIT_NAMES and name not in SHARD_MAP_NAMES:
+                continue
+            target = _unwrap_traced_target(node, self.imports)
+            if target is None:
+                continue
+            marked: List[FunctionScope] = []
+            if isinstance(target, ast.Lambda) and target in self._scope_by_node:
+                marked = [self._scope_by_node[target]]
+            elif isinstance(target, ast.Name):
+                marked = by_name.get(target.id, [])
+            elif (isinstance(target, ast.Attribute)
+                  and isinstance(target.value, ast.Name)
+                  and target.value.id == "self"):
+                marked = by_name.get(target.attr, [])
+            for scope in marked:
+                scope.jit_root = True
+                scope.jit_reason = scope.jit_reason or f"wrapped by {name}"
+                if name in JIT_NAMES:
+                    scope.static_args |= _static_arg_names(node, scope.node)
+                    scope.donate_argnums |= _donated_argnums(node)
+
+    def _mark_called_from_jit(self) -> None:
+        """One transitive step: module functions called by name from a jit
+        context are themselves traced (the `ops/` helper-library pattern:
+        pure functions invoked only from inside jitted programs)."""
+        by_name: Dict[str, List[FunctionScope]] = {}
+        for scope in self.scopes:
+            by_name.setdefault(scope.name, []).append(scope)
+        for _ in range(4):  # small fixpoint; call chains here are shallow
+            changed = False
+            jit_scopes = [s for s in self.scopes if self.in_jit_context(s)]
+            for scope in jit_scopes:
+                for node in ast.walk(scope.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = None
+                    if isinstance(node.func, ast.Name):
+                        callee = node.func.id
+                    elif (isinstance(node.func, ast.Attribute)
+                          and isinstance(node.func.value, ast.Name)
+                          and node.func.value.id == "self"):
+                        callee = node.func.attr
+                    if not callee:
+                        continue
+                    for cand in by_name.get(callee, []):
+                        if not cand.jit_root and cand.parent is None:
+                            cand.jit_root = True
+                            cand.jit_reason = (
+                                f"called from jit context "
+                                f"'{scope.name}' (line {node.lineno})")
+                            cand.transitive_call = (scope, node)
+                            changed = True
+            if not changed:
+                break
+
+    # -- queries -----------------------------------------------------------
+    def scope_of(self, fn: FunctionNode) -> Optional[FunctionScope]:
+        return self._scope_by_node.get(fn)
+
+    def in_jit_context(self, scope: FunctionScope) -> bool:
+        """True if the scope's body is traced: it is a jit root, or it is
+        nested (def-in-def) inside one."""
+        cur: Optional[FunctionScope] = scope
+        while cur is not None:
+            if cur.jit_root:
+                return True
+            cur = cur.parent
+        return False
+
+    def jit_scopes(self) -> List[FunctionScope]:
+        return [s for s in self.scopes if self.in_jit_context(s)]
+
+    def call_name(self, call: ast.Call) -> Optional[str]:
+        return self._resolve(call.func)

@@ -1,0 +1,59 @@
+"""JAX version compatibility shims.
+
+The engine targets the modern spelling ``jax.shard_map(..., check_vma=)``
+(JAX >= 0.6).  Older runtimes ship the same primitive as
+``jax.experimental.shard_map.shard_map(..., check_rep=)`` — identical
+semantics, different address and keyword.  :func:`install` bridges the
+gap in whichever direction is needed so every caller (library, tests,
+benchmarks) can use one spelling.
+
+Imported for its side effect from ``glt_tpu/__init__`` — safe to import
+multiple times, and a no-op when the running JAX already matches.
+"""
+from __future__ import annotations
+
+import functools
+
+
+def _wrap_check_vma(fn):
+    """Adapt a legacy ``check_rep`` shard_map to the ``check_vma`` API."""
+
+    @functools.wraps(fn)
+    def shard_map(f=None, /, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if f is None:
+            return lambda g: shard_map(g, *args, **kwargs)
+        return fn(f, *args, **kwargs)
+
+    return shard_map
+
+
+def install() -> None:
+    """Ensure ``jax.shard_map`` exists and accepts ``check_vma=``."""
+    import jax
+
+    try:
+        current = jax.shard_map
+    except AttributeError:
+        current = None
+    if current is not None:
+        # Modern JAX already accepts check_vma; nothing to do.
+        import inspect
+
+        try:
+            params = inspect.signature(current).parameters
+        except (TypeError, ValueError):
+            params = {}
+        if "check_vma" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()):
+            return
+        jax.shard_map = _wrap_check_vma(current)
+        return
+    from jax.experimental.shard_map import shard_map as legacy
+
+    jax.shard_map = _wrap_check_vma(legacy)
+
+
+install()

@@ -64,16 +64,25 @@ class DistDataset(NamedTuple):
         return self.relabel.old2new[np.asarray(old_ids)]
 
     def split_seeds(self, old_ids: np.ndarray, batch_size: int,
-                    shuffle: bool = False, seed: int = 0) -> np.ndarray:
+                    shuffle: bool = False, seed: int = 0,
+                    rng: Optional[np.random.Generator] = None) -> np.ndarray:
         """Group seeds by owner shard into ``[num_batches, S, B]`` (-1 pad).
 
         The per-rank disjoint seed split of the reference's trainers
         (dist_train_sage_supervised.py:76): shard ``s`` trains on the seeds
         it owns, so hop 0 of every batch needs no exchange.
+
+        ``rng``: a *stateful* Generator threaded by the caller.  A fresh
+        ``default_rng(seed)`` per call replays the identical permutation
+        every epoch — multi-epoch trainers pass their epoch-advancing
+        Generator (every host of a fleet must seed it identically so the
+        global batch layout agrees).  ``seed`` remains for single-shot
+        deterministic splits.
         """
         new = self.translate(old_ids)
         if shuffle:
-            new = new[np.random.default_rng(seed).permutation(new.shape[0])]
+            gen = rng if rng is not None else np.random.default_rng(seed)
+            new = new[gen.permutation(new.shape[0])]
         c = self.relabel.nodes_per_shard
         s_count = self.num_parts
         per_shard: List[np.ndarray] = [new[new // c == s]

@@ -42,16 +42,19 @@ class HeteroConv(nn.Module):
                 continue
             mask = edge_mask[et]
             # Bipartite message passing: stack src rows behind dst rows so
-            # a homogeneous conv can run on one node array.
+            # a homogeneous conv can run on one node array.  The conv's own
+            # input projections (lin_self/lin_nbr, lin) consume the raw
+            # rows — an extra per-type Dense in front would stack a second
+            # linear map that only slows optimization.  Src rows are
+            # aligned to the dst width only when the types' feature dims
+            # genuinely differ.
             n_dst = x[dst_t].shape[0]
-            n_src = x[src_t].shape[0]
-            dsrc = nn.Dense(self.out_features, dtype=dt,
-                            name=f"{as_str(et)}_src_proj")(
-                x[src_t]).astype(jnp.float32)
-            ddst = nn.Dense(self.out_features, dtype=dt,
-                            name=f"{as_str(et)}_dst_proj")(
-                x[dst_t]).astype(jnp.float32)
-            joint = jnp.concatenate([ddst, dsrc], axis=0)
+            src_rows = x[src_t]
+            if src_rows.shape[-1] != x[dst_t].shape[-1]:
+                src_rows = nn.Dense(x[dst_t].shape[-1], dtype=dt,
+                                    name=f"{as_str(et)}_align")(
+                    src_rows).astype(jnp.float32)
+            joint = jnp.concatenate([x[dst_t], src_rows], axis=0)
             ei_shift = jnp.stack([
                 jnp.where(ei[0] >= 0, ei[0] + n_dst, -1),  # src rows shifted
                 ei[1],                                      # dst rows as-is
@@ -91,8 +94,12 @@ class RGAT(nn.Module):
                              conv=self.conv, heads=self.heads,
                              dtype=self.dtype,
                              name=f"layer{i}")(h, edge_index, edge_mask)
-            # untouched types pass through
-            h = {t: nn.relu(out[t]) if t in out else h[t] for t in h}
+            # Residual per layer (the HGT layers here do the same via a
+            # gated skip): target-type identity features reach the head
+            # directly instead of having to survive every conv.
+            # Untouched types pass through.
+            h = {t: h[t] + nn.relu(out[t]) if t in out else h[t]
+                 for t in h}
             if train:
                 h = {t: nn.Dropout(self.dropout_rate,
                                    deterministic=False)(v)

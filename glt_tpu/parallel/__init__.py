@@ -1,3 +1,4 @@
+from .. import compat  # noqa: F401  (installs the jax.shard_map shim)
 from . import multihost
 from .sharding import (
     ShardedGraph,

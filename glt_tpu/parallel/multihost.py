@@ -89,6 +89,17 @@ def initialize(coordinator_address: Optional[str] = None,
     if process_id is None:
         r = os.environ.get("GLT_PROCESS_ID", os.environ.get("RANK"))
         process_id = int(r) if r is not None else None
+    # A multi-process CPU fleet needs a cross-process collectives
+    # implementation — without one XLA rejects the first process-spanning
+    # computation ("Multiprocess computations aren't implemented on the
+    # CPU backend").  Gloo ships in jaxlib; select it before the backend
+    # client is created.  TPU/GPU fleets ignore this knob.
+    if "cpu" in (os.environ.get("JAX_PLATFORMS")
+                 or jax.config.jax_platforms or ""):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):  # older/newer jax spellings
+            pass
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

@@ -483,7 +483,9 @@ class NeighborSampler(BaseSampler):
                                        (seeds_g, keys))
                 return outs
 
-            self._sample_many_jit[g] = jax.jit(many)
+            # One program per group count, cached in _sample_many_jit —
+            # the closure over `g` is the compile-cache key, not a leak.
+            self._sample_many_jit[g] = jax.jit(many)  # gltlint: disable=recompile-hazard
         gr = self.graph
         return self._sample_many_jit[g](gr.indptr, gr.indices,
                                         gr.gather_edge_ids, seeds, key)
@@ -698,7 +700,9 @@ class NeighborSampler(BaseSampler):
                                     edge_ids=sub_eids)
                 return base, sub
 
-            self._subgraph_jit[k] = jax.jit(fused)
+            # One program per max_degree, cached in _subgraph_jit — the
+            # baked `_k=k` default is the compile-cache key, not a leak.
+            self._subgraph_jit[k] = jax.jit(fused)  # gltlint: disable=recompile-hazard
         g = self.graph
         # gather_edge_ids for the hop loop (None when ids are positional
         # — skips identity gathers); real edge ids for the extract.

@@ -30,3 +30,5 @@ if _xb.backends_are_initialized():  # a fixture touched jax before us
     clear_backends()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import glt_tpu.compat  # noqa: E402,F401  (jax.shard_map version shim)

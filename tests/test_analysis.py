@@ -1,0 +1,517 @@
+"""gltlint rule tests: each rule fires on a violating fixture and stays
+silent on the clean twin; the CLI gate passes over glt_tpu itself.
+
+Fixtures are minimal but idiomatic — the same import spellings the real
+tree uses (``import jax.numpy as jnp``, ``from functools import partial``)
+so alias resolution is exercised, not bypassed.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from glt_tpu.analysis import Severity, analyze_source
+from glt_tpu.analysis.rules import RULES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def findings_for(src, rule=None):
+    out = analyze_source(textwrap.dedent(src), "fixture.py")
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GLT001 host-sync-in-jit
+# ---------------------------------------------------------------------------
+
+class TestHostSyncInJit:
+    def test_positive_np_asarray_on_traced(self):
+        src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x) + 1
+        """
+        hits = findings_for(src, "host-sync-in-jit")
+        assert len(hits) == 1
+        assert hits[0].severity is Severity.ERROR
+        assert "np" not in hits[0].rule  # sanity: rule name, not module
+
+    def test_positive_item_inside_wrapped_method(self):
+        src = """
+        import jax
+
+        class S:
+            def __init__(self):
+                self._fn = jax.jit(self._impl)
+
+            def _impl(self, ids):
+                return ids.sum().item()
+        """
+        assert len(findings_for(src, "host-sync-in-jit")) == 1
+
+    def test_positive_int_on_traced_param(self):
+        src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            n = int(x)
+            return n
+        """
+        assert len(findings_for(src, "host-sync-in-jit")) == 1
+
+    def test_negative_host_side_and_static(self):
+        src = """
+        import jax
+        import numpy as np
+
+        def host_stage(ids):
+            return np.asarray(ids)          # not a jit context
+
+        @jax.jit
+        def f(x):
+            b = int(x.shape[0])             # .shape is static under jit
+            return x * b
+
+        @jax.jit
+        def g(x, n):
+            return x + np.float32(1.0)      # constant, no traced operand
+        """
+        assert findings_for(src, "host-sync-in-jit") == []
+
+    def test_negative_static_argnames_excluded(self):
+        src = """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            return x * int(n)
+        """
+        assert findings_for(src, "host-sync-in-jit") == []
+
+    def test_transitive_helper_with_static_args_clean(self):
+        # the bounded_remote_cap shape: helper called from jit with
+        # Python config values only
+        src = """
+        import jax
+
+        def cap(width, load):
+            return int(round(load * width))
+
+        @jax.jit
+        def f(x):
+            c = cap(4, 2.0)
+            return x[:c]
+        """
+        assert findings_for(src, "host-sync-in-jit") == []
+
+    def test_transitive_helper_with_traced_arg_fires(self):
+        src = """
+        import jax
+        import numpy as np
+
+        def helper(v):
+            return np.asarray(v)
+
+        @jax.jit
+        def f(x):
+            return helper(x * 2)
+        """
+        assert len(findings_for(src, "host-sync-in-jit")) == 1
+
+
+# ---------------------------------------------------------------------------
+# GLT002 prng-key-reuse
+# ---------------------------------------------------------------------------
+
+class TestPrngKeyReuse:
+    def test_positive_double_draw(self):
+        src = """
+        import jax
+
+        def sample(key):
+            a = jax.random.uniform(key, (4,))
+            b = jax.random.normal(key, (4,))
+            return a + b
+        """
+        hits = findings_for(src, "prng-key-reuse")
+        assert len(hits) == 1
+        assert "key" in hits[0].message
+
+    def test_positive_reuse_after_local_key(self):
+        src = """
+        import jax
+
+        def sample(x):
+            k = jax.random.PRNGKey(0)
+            a = jax.random.uniform(k, (4,))
+            b = jax.random.uniform(k, (4,))
+            return a + b
+        """
+        assert len(findings_for(src, "prng-key-reuse")) == 1
+
+    def test_negative_split_and_fold_in(self):
+        src = """
+        import jax
+
+        def sample(key):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.uniform(k1, (4,))
+            b = jax.random.normal(k2, (4,))
+            for i in range(3):
+                ki = jax.random.fold_in(key, i)   # deriving is fine
+                b = b + jax.random.uniform(ki, (4,))
+            return a + b
+        """
+        assert findings_for(src, "prng-key-reuse") == []
+
+    def test_negative_branches_use_once_each(self):
+        src = """
+        import jax
+
+        def sample(key, flag):
+            if flag:
+                return jax.random.uniform(key, (4,))
+            else:
+                return jax.random.normal(key, (4,))
+        """
+        assert findings_for(src, "prng-key-reuse") == []
+
+    def test_negative_reassignment_resets(self):
+        src = """
+        import jax
+
+        def sample(key):
+            a = jax.random.uniform(key, (4,))
+            key = jax.random.fold_in(key, 1)
+            b = jax.random.uniform(key, (4,))
+            return a + b
+        """
+        assert findings_for(src, "prng-key-reuse") == []
+
+
+# ---------------------------------------------------------------------------
+# GLT003 recompile-hazard
+# ---------------------------------------------------------------------------
+
+class TestRecompileHazard:
+    def test_positive_closure_over_scalar(self):
+        src = """
+        import jax
+
+        def build(x):
+            n = x.shape[0]
+            fn = jax.jit(lambda a: a * n)
+            return fn
+        """
+        hits = findings_for(src, "recompile-hazard")
+        assert len(hits) == 1
+        assert "'n'" in hits[0].message
+
+    def test_positive_nested_def_capture(self):
+        src = """
+        import jax
+
+        def build(batches):
+            width = len(batches)
+
+            def body(a):
+                return a + width
+
+            return jax.jit(body)
+        """
+        assert len(findings_for(src, "recompile-hazard")) == 1
+
+    def test_negative_static_argnums(self):
+        src = """
+        import jax
+
+        def build(x):
+            n = x.shape[0]
+            fn = jax.jit(lambda a, m: a * m, static_argnums=(1,))
+            return fn, n
+        """
+        assert findings_for(src, "recompile-hazard") == []
+
+    def test_negative_no_scalar_capture(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        def build(rows):
+            table = jnp.asarray(rows, jnp.float32)   # array capture: fine
+            return jax.jit(lambda ids: table[ids])
+        """
+        assert findings_for(src, "recompile-hazard") == []
+
+    def test_suppression_comment(self):
+        src = """
+        import jax
+
+        def build(x):
+            n = x.shape[0]
+            fn = jax.jit(lambda a: a * n)  # gltlint: disable=recompile-hazard -- cached per n
+            return fn
+        """
+        assert findings_for(src, "recompile-hazard") == []
+
+
+# ---------------------------------------------------------------------------
+# GLT004 int64-id-truncation
+# ---------------------------------------------------------------------------
+
+class TestInt64IdTruncation:
+    def test_positive_astype_flow(self):
+        src = """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def load(ids):
+            ids64 = np.asarray(ids).astype(np.int64)
+            return jnp.asarray(ids64)
+        """
+        hits = findings_for(src, "int64-id-truncation")
+        assert len(hits) == 1
+        assert hits[0].severity is Severity.ERROR
+
+    def test_positive_dtype_kwarg_source(self):
+        src = """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def load(n):
+            eids = np.arange(n, dtype=np.int64)
+            return jnp.array(eids)
+        """
+        assert len(findings_for(src, "int64-id-truncation")) == 1
+
+    def test_negative_explicit_dtype(self):
+        src = """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def load(ids):
+            ids64 = np.asarray(ids).astype(np.int64)
+            a = jnp.asarray(ids64, jnp.int32)        # positional dtype
+            b = jnp.asarray(ids64, dtype=jnp.int32)  # keyword dtype
+            mask = ids64 >= 0                        # bool, not ids
+            return a, b, jnp.asarray(mask)
+        """
+        assert findings_for(src, "int64-id-truncation") == []
+
+
+# ---------------------------------------------------------------------------
+# GLT005 nondeterministic-default-rng
+# ---------------------------------------------------------------------------
+
+class TestNondeterministicDefaultRng:
+    def test_positive_unseeded(self):
+        src = """
+        import numpy as np
+
+        def shuffle(ids):
+            return np.random.default_rng().permutation(ids)
+        """
+        hits = findings_for(src, "nondeterministic-default-rng")
+        assert len(hits) == 1
+        assert hits[0].severity is Severity.WARNING
+
+    def test_positive_explicit_none(self):
+        src = """
+        import numpy as np
+
+        rng = np.random.default_rng(None)
+        """
+        assert len(findings_for(src, "nondeterministic-default-rng")) == 1
+
+    def test_positive_fresh_generator_per_call(self):
+        # the dist_dataset.py:76 bug: a fresh default_rng(seed) drawn
+        # inline inside a function whose seed is a parameter replays the
+        # identical permutation on every call (epoch)
+        src = """
+        import numpy as np
+
+        def split(ids, seed=0):
+            return np.random.default_rng(seed).permutation(ids)
+        """
+        hits = findings_for(src, "nondeterministic-default-rng")
+        assert len(hits) == 1
+        assert "replays" in hits[0].message
+
+    def test_negative_seeded_one_shot_and_threaded(self):
+        src = """
+        import numpy as np
+
+        FIXTURE = np.random.default_rng(0).permutation(16)   # one-shot
+
+        def split(ids, rng: np.random.Generator):
+            return rng.permutation(ids)                      # threaded
+
+        def per_step(ids, step):
+            # per-call-varying seed: a deliberate stream
+            return np.random.default_rng(step * 7 + 1).permutation(ids)
+        """
+        assert findings_for(src, "nondeterministic-default-rng") == []
+
+
+# ---------------------------------------------------------------------------
+# GLT006 shadowed-jit-donation
+# ---------------------------------------------------------------------------
+
+class TestShadowedJitDonation:
+    def test_positive_use_after_donation(self):
+        src = """
+        import jax
+
+        step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+
+        def train(state, batch):
+            out = step(state, batch)
+            return out + state.sum()     # state's buffer is gone
+        """
+        hits = findings_for(src, "shadowed-jit-donation")
+        assert len(hits) == 1
+        assert "'state'" in hits[0].message
+
+    def test_positive_decorated_donation(self):
+        src = """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def step(state, scratch):
+            return state + scratch
+
+        def loop(state, scratch):
+            state = step(state, scratch)
+            return state, scratch.shape  # read after donate
+        """
+        assert len(findings_for(src, "shadowed-jit-donation")) == 1
+
+    def test_negative_reassigned_from_result(self):
+        src = """
+        import jax
+
+        step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+
+        def train(state, batches):
+            for b in batches:
+                state = step(state, b)   # donated then rebound
+            return state
+        """
+        assert findings_for(src, "shadowed-jit-donation") == []
+
+    def test_negative_undonated_args_free(self):
+        src = """
+        import jax
+
+        step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+
+        def train(state, batch):
+            out = step(state, batch)
+            return out + batch.sum()     # batch was not donated
+        """
+        assert findings_for(src, "shadowed-jit-donation") == []
+
+
+# ---------------------------------------------------------------------------
+# suppression / report plumbing
+# ---------------------------------------------------------------------------
+
+class TestSuppression:
+    SRC = """
+    import numpy as np
+
+    a = np.random.default_rng()
+    """
+
+    def test_line_disable_by_name_and_code(self):
+        for tag in ("nondeterministic-default-rng", "GLT005", "all"):
+            src = self.SRC.replace(
+                "default_rng()", f"default_rng()  # gltlint: disable={tag}")
+            assert findings_for(src) == []
+
+    def test_disable_next_line(self):
+        src = """
+        import numpy as np
+
+        # gltlint: disable-next=GLT005 -- entropy wanted here
+        a = np.random.default_rng()
+        """
+        assert findings_for(src) == []
+
+    def test_disable_file(self):
+        src = """
+        # gltlint: disable-file=nondeterministic-default-rng
+        import numpy as np
+
+        a = np.random.default_rng()
+        b = np.random.default_rng()
+        """
+        assert findings_for(src) == []
+
+    def test_unsuppressed_still_fires(self):
+        assert len(findings_for(self.SRC)) == 1
+
+    def test_parse_error_is_a_finding(self):
+        bad = "def f(:\n    pass\n"
+        out = analyze_source(bad, "broken.py")
+        assert len(out) == 1 and out[0].rule == "parse-error"
+
+
+# ---------------------------------------------------------------------------
+# the gate itself
+# ---------------------------------------------------------------------------
+
+def test_rule_registry_complete():
+    assert set(RULES) == {
+        "host-sync-in-jit", "prng-key-reuse", "recompile-hazard",
+        "int64-id-truncation", "nondeterministic-default-rng",
+        "shadowed-jit-donation",
+    }
+
+
+def test_cli_clean_on_glt_tpu():
+    """The shipped tree must lint clean: ``python -m glt_tpu.analysis
+    glt_tpu`` exits 0 (the CI gate)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "glt_tpu.analysis", "glt_tpu"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 error(s)" in proc.stdout
+
+
+def test_cli_flags_a_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x)
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "glt_tpu.analysis", str(bad)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "GLT001" in proc.stdout
+
+
+def test_cli_list_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "glt_tpu.analysis", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    for code in ("GLT001", "GLT002", "GLT003", "GLT004", "GLT005",
+                 "GLT006"):
+        assert code in proc.stdout

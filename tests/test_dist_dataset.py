@@ -108,6 +108,22 @@ class TestDistDatasetLoad:
         assert sorted(flat.tolist()) == sorted(
             ds.translate(np.arange(N)).tolist())
 
+    def test_split_seeds_rng_advances_across_epochs(self, part_dir):
+        """A threaded stateful Generator gives a fresh permutation per
+        call (epoch); the legacy seed path replays one permutation."""
+        root, _, _, labels = part_dir
+        ds = DistDataset.load(root, labels=labels)
+        rng = np.random.default_rng(5)
+        e1 = ds.split_seeds(np.arange(N), 4, shuffle=True, rng=rng)
+        e2 = ds.split_seeds(np.arange(N), 4, shuffle=True, rng=rng)
+        assert not np.array_equal(e1, e2)
+        # same multiset of seeds either way
+        assert sorted(e1[e1 >= 0].tolist()) == sorted(e2[e2 >= 0].tolist())
+        # seed path stays deterministic call-to-call (fleet agreement)
+        s1 = ds.split_seeds(np.arange(N), 4, shuffle=True, seed=7)
+        s2 = ds.split_seeds(np.arange(N), 4, shuffle=True, seed=7)
+        np.testing.assert_array_equal(s1, s2)
+
     def test_partition_to_mesh_train_loss_drops(self, part_dir):
         """The VERDICT round-1 gap: FrequencyPartitioner/RandomPartitioner
         output dir -> running distributed train step (dist_dataset.py:77)."""

@@ -217,7 +217,9 @@ def test_hetero_tiered_train_matches_full():
     staged = pipe._stage_cold_async(out).result()
     k = jax.random.PRNGKey(3)
     _, loss_t, acc_t = train_tier(state, out, staged, k)
-    _, loss_f, acc_f = train_full(state, out, {}, k)
+    # Parity check: BOTH paths must consume the identical key so tiered
+    # and full training are bit-comparable.
+    _, loss_f, acc_f = train_full(state, out, {}, k)  # gltlint: disable=prng-key-reuse
     np.testing.assert_allclose(float(loss_t), float(loss_f), rtol=1e-6)
     np.testing.assert_allclose(float(acc_t), float(acc_f), rtol=1e-6)
     assert pipe.flush_dropped() == 0
