@@ -12,17 +12,29 @@ TPU redesign — no UVA, no IPC handles:
   across a mesh is the :mod:`glt_tpu.parallel` layer's job, the analog of
   the reference's ``DeviceGroup`` replication, feature.py:31-45);
 * the **cold tier** stays in host numpy and is gathered eagerly on the
-  host, overlapped with device compute by the loader's prefetch pipeline —
-  the role UVA reads played on GPU (the TPU runtime in use does not support
-  host callbacks inside jit, so the cold path is a host-side stage, exactly
-  where the reference put its CPU fallback, feature.py:156);
+  host — and ONLY at the batch positions that actually resolve cold: the
+  host moves ``n_cold`` rows, not ``B`` rows, and the hot/cold merge is a
+  padded device scatter instead of a double full-batch materialization;
+* an optional **cross-batch HBM cache** (:mod:`.feature_cache`) fronts the
+  cold tier: recently fetched cold rows stay device-resident, so repeat
+  lookups (hub nodes under power-law sampling) skip the host entirely —
+  the TPU seat of the reference's ``UnifiedTensor`` hotness cache.  Enable
+  with :meth:`Feature.enable_cold_cache`; hit/miss counters ride on device
+  and surface through :meth:`Feature.cache_stats`.
 * the ``id2index`` indirection (feature.py:141-154) is identical: lookups
   translate global ids through the hotness reordering of
   :func:`~glt_tpu.data.reorder.sort_by_in_degree`.
 
 ``gather`` is jit-safe when the store is fully device-resident
 (``split_ratio == 1.0``); tiered stores gather eagerly with a static output
-shape ``[B, d]``.  Padding ids (< 0) return zero rows either way.
+shape ``[B, d]``.  Padding ids (< 0) return zero rows either way.  With
+``dedup=True`` device gathers route through
+:func:`~glt_tpu.ops.dedup_gather.dedup_gather_rows` — bit-identical
+output, each unique row fetched from HBM once.
+
+Ids must fit int32 (GLT004): int64 id arrays are accepted but their
+VALUES are range-checked before the cast — silent truncation raises
+``OverflowError`` instead of corrupting the gather.
 """
 from __future__ import annotations
 
@@ -31,6 +43,37 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .feature_cache import cache_init, cache_insert, cache_lookup
+
+_I32_MAX = np.iinfo(np.int32).max
+_I32_MIN = np.iinfo(np.int32).min
+
+
+def require_int32_ids(ids) -> None:
+    """GLT004 guard: refuse id VALUES that overflow int32.
+
+    The whole engine runs int32 ids on device (x64 is disabled); a host
+    int64 id array is fine as long as every value fits — otherwise the
+    downcast silently truncates and the gather reads the wrong rows.
+    Host-side check only (device arrays are already int32-typed; checking
+    their values would force a sync).
+    """
+    if isinstance(ids, jax.core.Tracer) or isinstance(ids, jax.Array):
+        return
+    a = np.asarray(ids)
+    if a.dtype.kind in "iu" and a.dtype.itemsize > 4 and a.size:
+        mx, mn = int(a.max()), int(a.min())
+        if mx > _I32_MAX or mn < _I32_MIN:
+            raise OverflowError(
+                f"node ids [{mn}, {mx}] overflow int32; the id space must "
+                f"fit int32 (relabel/partition first — GLT004)")
+
+
+def _pow2_pad(k: int) -> int:
+    """Bucket a dynamic count to the next power of two (bounds the jit
+    retrace count of the padded merge scatter to log2(B))."""
+    return 1 if k <= 1 else 1 << (k - 1).bit_length()
 
 
 class Feature:
@@ -43,6 +86,9 @@ class Feature:
         on host).  1.0 = fully device-resident, 0.0 = fully host.
       id2index: optional ``[N]`` indirection from global id to row.
       dtype: optional cast applied to gathered rows (e.g. ``jnp.bfloat16``).
+      dedup: route device gathers through the dedup-aware path (each
+        unique row fetched once; output bit-identical to the naive
+        gather).
     """
 
     def __init__(
@@ -51,6 +97,7 @@ class Feature:
         split_ratio: float = 1.0,
         id2index: Optional[np.ndarray] = None,
         dtype=None,
+        dedup: bool = False,
     ):
         feature_array = np.asarray(feature_array)
         if feature_array.ndim == 1:
@@ -59,6 +106,7 @@ class Feature:
         self.split_ratio = float(split_ratio)
         self._hot_count = int(self._n * self.split_ratio)
         self.dtype = dtype or jnp.asarray(feature_array[:1]).dtype
+        self.dedup = bool(dedup)
 
         self._hot = jnp.asarray(feature_array[: self._hot_count], self.dtype)
         # Host tier; kept as a contiguous numpy view for fast np.take.
@@ -68,18 +116,25 @@ class Feature:
         self._id2index_np = (
             None if id2index is None else np.asarray(id2index, np.int32))
         self._host_full = feature_array  # for cpu_get / save paths
-        self._gather_jit = None
+        self._gather_jit = None          # device-array ids (no donation)
+        self._gather_jit_host = None     # host ids: fresh buffer, donated
+        self._cache = None               # optional cold-tier HBM cache
+        self._cache_lookup_jit = None
+        self._merge_cached_jit = None
+        self._merge_jit = None
 
-    @staticmethod
-    def _gather_hot_impl(hot, id2index, ids):
+    def _gather_hot_impl(self, hot, id2index, ids):
+        from ..ops.dedup_gather import dedup_gather_rows
         from ..ops.gather_pallas import gather_rows
 
+        ids = ids.astype(jnp.int32)
+        if self.dedup:
+            # unique -> gather uniques -> scatter back (bit-identical).
+            return dedup_gather_rows(hot, ids, id2index=id2index)
         valid = ids >= 0
         idx = jnp.where(valid, ids, 0)
         if id2index is not None:
             idx = id2index[idx]
-        # XLA gather (measured 2x the Pallas DMA kernel; see
-        # ops/gather_pallas.py docstring).
         rows = gather_rows(hot, idx)
         return jnp.where(valid[:, None], rows, 0)
 
@@ -105,60 +160,181 @@ class Feature:
         """The HBM-resident hot tier ``[hot_count, d]`` as a jax.Array."""
         return self._hot
 
+    # -- cold-tier cache ---------------------------------------------------
+    def enable_cold_cache(self, capacity: int) -> None:
+        """Attach a device-resident cache in front of the host cold tier.
+
+        ``capacity`` rows of the cold tier stay resident in HBM (FIFO
+        replacement); tiered ``gather`` calls then host-fetch only the
+        cache MISSES.  Costs one device->host fetch of the ``[B]`` hit
+        mask per gather (the host must know which rows to stage — the
+        same sync the loader's overflow check already pays).
+        """
+        if self._cold.shape[0] == 0:
+            raise ValueError(
+                "cold cache needs a host tier (split_ratio < 1.0)")
+        self._cache = cache_init(self._cold.shape[0], int(capacity),
+                                 self._dim, self.dtype)
+        self._cache_lookup_jit = jax.jit(cache_lookup)
+
+    def cache_stats(self) -> Optional[dict]:
+        """Cold-cache hit/miss counters (host sync), or None."""
+        if self._cache is None:
+            return None
+        from .feature_cache import cache_stats as _stats
+
+        return _stats(self._cache)
+
     # -- gather ------------------------------------------------------------
     def gather(self, ids: jnp.ndarray) -> jnp.ndarray:
         """Gather rows for global ``ids`` (-1 padded).
 
         Fully device-resident stores (``split_ratio == 1.0``) are jit-safe.
         Tiered stores run the hot gather on device and the cold gather on
-        host, merging on device — callable only eagerly (the loader stages
-        it before the jitted train step).  Padding rows are zeros.
+        host — touching each tier only at its own batch positions — and
+        merge with a padded device scatter; callable only eagerly (the
+        loader stages it before the jitted train step).  Padding rows are
+        zeros.
         """
         if self._cold.shape[0] == 0:
             if isinstance(ids, jax.core.Tracer):
                 # Already inside an enclosing jit: trace inline.
                 return self._gather_hot_impl(self._hot, self._id2index,
                                              jnp.asarray(ids, jnp.int32))
+            require_int32_ids(ids)
             # Eager call sites (loader collate): ONE fused dispatch
-            # instead of per-op dispatches (tunnel-latency bound).
-            if self._gather_jit is None:
-                self._gather_jit = jax.jit(self._gather_hot_impl)
-            return self._gather_jit(self._hot, self._id2index,
-                                    jnp.asarray(ids, jnp.int32))
+            # instead of per-op dispatches (tunnel-latency bound).  Host
+            # ids arrive via a fresh device buffer that nothing else
+            # references, so that buffer is donated; device-array ids
+            # belong to the caller (e.g. ``out.node``, reused for the
+            # label gather) and are NOT donated.
+            donate = (not isinstance(ids, jax.Array)
+                      and jax.default_backend() != "cpu")
+            if not donate:
+                if self._gather_jit is None:
+                    self._gather_jit = jax.jit(self._gather_hot_impl)
+                return self._gather_jit(self._hot, self._id2index,
+                                        jnp.asarray(ids, jnp.int32))
+            if self._gather_jit_host is None:
+                self._gather_jit_host = jax.jit(self._gather_hot_impl,
+                                                donate_argnums=(2,))
+            return self._gather_jit_host(self._hot, self._id2index,
+                                         jnp.asarray(ids, jnp.int32))
 
         if isinstance(ids, jax.core.Tracer):
             raise ValueError(
                 "tiered Feature.gather (split_ratio < 1) is a host-side "
                 "stage and cannot run under jit; gather before the jitted "
                 "step or use split_ratio=1.0")
+        require_int32_ids(ids)
         ids_np = np.asarray(ids).astype(np.int64)
         valid = ids_np >= 0
         idx = np.where(valid, ids_np, 0)
         if self._id2index_np is not None:
-            idx = self._id2index_np[idx]
+            idx = self._id2index_np[idx].astype(np.int64)
         is_hot = idx < self._hot_count
-        cold_np = np.take(self._cold,
-                          np.clip(np.where(is_hot, 0, idx - self._hot_count),
-                                  0, max(self._cold.shape[0] - 1, 0)),
-                          axis=0)
-        cold_rows = jnp.asarray(cold_np, self.dtype)
-        vmask = jnp.asarray(valid)[:, None]
-        if self._hot_count == 0:
-            # Fully host-resident (split_ratio == 0, e.g. a shared-memory
-            # attach in a sampling worker): no device hot tier to gather.
-            return jnp.where(vmask, cold_rows, 0)
-        # Device gather for the hot rows, host gather for the cold rows.
-        hot_rows = jnp.take(self._hot,
-                            jnp.asarray(np.where(is_hot, idx, 0), jnp.int32),
-                            axis=0, mode="clip")
-        mask = jnp.asarray(is_hot & valid)[:, None]
-        return jnp.where(mask, hot_rows, jnp.where(vmask, cold_rows, 0))
+        hot_mask = valid & is_hot
+        cold_mask = valid & ~is_hot
+        if self._cache is not None:
+            return self._gather_tiered_cached(idx, hot_mask, cold_mask)
+        cold_pos = np.nonzero(cold_mask)[0]
+        # Host moves ONLY the cold rows (was: full-batch np.take of both
+        # tiers + masked merge).
+        cold_np = self._cold[idx[cold_pos] - self._hot_count]
+        cap = _pow2_pad(cold_pos.shape[0])
+        b = ids_np.shape[0]
+        pos_pad = np.full((cap,), b, np.int32)      # b = out-of-range: drop
+        pos_pad[: cold_pos.shape[0]] = cold_pos
+        rows_pad = np.zeros((cap, self._dim), self._cold.dtype)
+        rows_pad[: cold_pos.shape[0]] = cold_np
+        return self._merge_tiered(
+            jnp.asarray(np.where(hot_mask, idx, 0), jnp.int32),
+            jnp.asarray(hot_mask), jnp.asarray(pos_pad),
+            jnp.asarray(rows_pad, self.dtype))
+
+    def _merge_tiered(self, idx, hot_mask, cold_pos, cold_rows):
+        """Device merge: hot gather at hot slots + cold-row scatter."""
+        if self._merge_jit is None:
+            @jax.jit
+            def merge(hot, idx, hot_mask, cold_pos, cold_rows):
+                if hot.shape[0]:
+                    out = jnp.where(
+                        hot_mask[:, None],
+                        jnp.take(hot, idx, axis=0, mode="clip"), 0)
+                else:
+                    # Fully host-resident (split_ratio == 0, e.g. a
+                    # shared-memory attach in a sampling worker).
+                    out = jnp.zeros((idx.shape[0], cold_rows.shape[1]),
+                                    cold_rows.dtype)
+                return out.at[cold_pos].set(cold_rows, mode="drop")
+
+            self._merge_jit = merge
+        return self._merge_jit(self._hot, idx, hot_mask, cold_pos,
+                               cold_rows)
+
+    def _gather_tiered_cached(self, idx, hot_mask, cold_mask):
+        """Tiered gather with the HBM cold cache in front of the host.
+
+        One device->host sync (the hit mask); the host stages only cache
+        misses, and the merge program inserts them into the cache for the
+        next batch (the previous cache buffers are donated in place).
+        """
+        b = idx.shape[0]
+        cold_ids = np.where(cold_mask, idx - self._hot_count, -1).astype(
+            np.int32)
+        cold_ids_dev = jnp.asarray(cold_ids)
+        rows_c, hit = self._cache_lookup_jit(self._cache, cold_ids_dev)
+        hit_np = np.asarray(hit)                      # the one sync
+        miss_mask = cold_mask & ~hit_np
+        miss_pos = np.nonzero(miss_mask)[0]
+        miss_np = self._cold[idx[miss_pos] - self._hot_count]
+        cap = _pow2_pad(miss_pos.shape[0])
+        pos_pad = np.full((cap,), b, np.int32)
+        pos_pad[: miss_pos.shape[0]] = miss_pos
+        rows_pad = np.zeros((cap, self._dim), self._cold.dtype)
+        rows_pad[: miss_pos.shape[0]] = miss_np
+
+        if self._merge_cached_jit is None:
+            @jax.jit
+            def merge_cached(cache, hot, idx, hot_mask, rows_c, hit,
+                             cold_ids, miss_mask, cold_pos, cold_rows):
+                if hot.shape[0]:
+                    out = jnp.where(
+                        hot_mask[:, None],
+                        jnp.take(hot, idx, axis=0, mode="clip"), 0)
+                else:
+                    out = jnp.zeros((idx.shape[0], rows_c.shape[1]),
+                                    rows_c.dtype)
+                out = jnp.where(hit[:, None], rows_c.astype(out.dtype), out)
+                out = out.at[cold_pos].set(cold_rows.astype(out.dtype),
+                                           mode="drop")
+                # Insert the staged miss rows; out at miss positions holds
+                # exactly the host-fetched cold rows.
+                cache = cache_insert(
+                    cache, jnp.where(miss_mask, cold_ids, -1), out,
+                    miss_mask)
+                cache = cache._replace(
+                    hits=cache.hits + jnp.sum(hit.astype(jnp.int32)),
+                    misses=cache.misses
+                    + jnp.sum(miss_mask.astype(jnp.int32)))
+                return cache, out
+
+            self._merge_cached_jit = merge_cached
+
+        self._cache, out = self._merge_cached_jit(
+            self._cache, self._hot,
+            jnp.asarray(np.where(hot_mask, idx, 0), jnp.int32),
+            jnp.asarray(hot_mask), rows_c, hit, cold_ids_dev,
+            jnp.asarray(miss_mask), jnp.asarray(pos_pad),
+            jnp.asarray(rows_pad, self.dtype))
+        return out
 
     def __getitem__(self, ids) -> jnp.ndarray:
         return self.gather(jnp.atleast_1d(jnp.asarray(ids)))
 
     def cpu_get(self, ids: np.ndarray) -> np.ndarray:
         """Pure host-side lookup (cf. feature.py:156 ``cpu_get``)."""
+        require_int32_ids(ids)
         ids = np.atleast_1d(np.asarray(ids))
         valid = ids >= 0
         idx = np.where(valid, ids, 0)

@@ -1,0 +1,157 @@
+"""Cross-batch device-resident feature-row cache (HBM, static shapes).
+
+The TPU analog of the reference's ``UnifiedTensor`` hotness cache
+(csrc/cuda/unified_tensor.cu, python/data/feature.py ``split_ratio``): on
+GPU the hot rows live in device memory and the rest is read through UVA;
+the cache's job is to keep recently touched rows on the fast side of that
+seam.  Here the seam is in front of whatever backing store serves a
+``Feature`` — the HBM hot tier (fused in-jit paths) or the host cold tier
+(the eager tiered path, where a hit saves a real host->device transfer).
+
+Everything is **functional and jit-safe**: the cache is a
+:class:`FeatureCacheState` pytree threaded through the caller (scan
+carries, donated jit arguments), updated with pure scatters — no host
+sync anywhere.  Replacement is FIFO over a clock hand: misses claim
+consecutive slots, evicting the oldest resident (the id->slot map entry
+of the evicted id is cleared in the same program).  Hit/miss counters
+ride as device scalars and are exported to the bench via
+:func:`cache_stats` (one fetch, after the timed region).
+
+Layout (``C`` = capacity, ``N`` = id space, ``d`` = row width):
+  * ``table``    ``[C + 1, d]``  cached rows; row ``C`` absorbs masked
+    scatter writes (the dump-row trick of ``ops.unique.dense_induce``).
+  * ``slot_ids`` ``[C + 1]``     global id resident in each slot (-1 empty).
+  * ``id2slot``  ``[N + 2]``     id -> slot (-1 absent); entry ``N`` is the
+    padding read slot (never written, always -1), entry ``N + 1`` the
+    write dump.
+  * ``clock/hits/misses``        int32 device scalars (counters wrap at
+    2^31 — fine for bench epochs, not for year-long jobs).
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+
+class FeatureCacheState(NamedTuple):
+    table: jnp.ndarray     # [C + 1, d]
+    slot_ids: jnp.ndarray  # [C + 1] int32
+    id2slot: jnp.ndarray   # [N + 2] int32
+    clock: jnp.ndarray     # [] int32 FIFO hand
+    hits: jnp.ndarray      # [] int32 cumulative
+    misses: jnp.ndarray    # [] int32 cumulative
+
+    @property
+    def capacity(self) -> int:
+        return self.slot_ids.shape[0] - 1
+
+    @property
+    def dim(self) -> int:
+        return self.table.shape[-1]
+
+
+def cache_init(num_ids: int, capacity: int, dim: int,
+               dtype=jnp.float32) -> FeatureCacheState:
+    """Empty cache over an id space of ``num_ids`` global ids."""
+    if capacity <= 0:
+        raise ValueError(f"cache capacity must be positive, got {capacity}")
+    return FeatureCacheState(
+        table=jnp.zeros((capacity + 1, dim), dtype),
+        slot_ids=jnp.full((capacity + 1,), -1, jnp.int32),
+        id2slot=jnp.full((num_ids + 2,), -1, jnp.int32),
+        clock=jnp.zeros((), jnp.int32),
+        hits=jnp.zeros((), jnp.int32),
+        misses=jnp.zeros((), jnp.int32),
+    )
+
+
+def cache_lookup(state: FeatureCacheState, ids: jnp.ndarray):
+    """Probe the cache for ``ids`` (-1 = padding).  jit-safe, read-only.
+
+    Returns ``(rows, hit)``: ``[M, d]`` rows (zeros at misses/padding)
+    and the ``[M]`` bool hit mask.
+    """
+    n = state.id2slot.shape[0] - 2
+    valid = ids >= 0
+    slot = state.id2slot[jnp.where(valid, jnp.clip(ids, 0, n - 1), n)]
+    hit = valid & (slot >= 0)
+    c_dump = state.table.shape[0] - 1
+    rows = jnp.take(state.table, jnp.where(hit, slot, c_dump), axis=0)
+    return jnp.where(hit[:, None], rows, 0), hit
+
+
+def cache_insert(state: FeatureCacheState, ids: jnp.ndarray,
+                 rows: jnp.ndarray, want: jnp.ndarray) -> FeatureCacheState:
+    """Insert ``rows`` for ``ids`` where ``want`` (FIFO eviction).
+
+    Contract: the wanted ids are unique among themselves and NOT
+    currently resident (i.e. ``want`` is a subset of a fresh lookup's
+    miss mask) — :func:`cache_gather` guarantees this.  If more ids are
+    wanted than the capacity, only the first ``C`` (in position order)
+    are inserted.  Counters are untouched (see :func:`cache_gather`).
+    """
+    cap = state.slot_ids.shape[0] - 1
+    n = state.id2slot.shape[0] - 2
+    ids = ids.astype(jnp.int32)
+    do = want & (ids >= 0)
+    rank = jnp.cumsum(do.astype(jnp.int32)) - 1
+    do = do & (rank < cap)
+    slot = lax.rem(state.clock + rank, cap)
+    wslot = jnp.where(do, slot, cap)  # dump slot for masked writes
+    # Evict: clear the id->slot entry of each slot's current resident.
+    # An evicted id can never equal an inserted id (inserted ids are not
+    # resident by contract), so clear-then-set ordering is safe.
+    evicted = jnp.where(do, state.slot_ids[wslot], -1)
+    id2slot = state.id2slot.at[
+        jnp.where(evicted >= 0, evicted, n + 1)].set(-1)
+    id2slot = id2slot.at[jnp.where(do, ids, n + 1)].set(
+        jnp.where(do, slot, -1))
+    slot_ids = state.slot_ids.at[wslot].set(jnp.where(do, ids, -1))
+    table = state.table.at[wslot].set(rows.astype(state.table.dtype))
+    clock = lax.rem(state.clock + jnp.sum(do.astype(jnp.int32)), cap)
+    return state._replace(table=table, slot_ids=slot_ids,
+                          id2slot=id2slot, clock=clock)
+
+
+def cache_gather(state: FeatureCacheState, ids: jnp.ndarray,
+                 fetch: Callable[[jnp.ndarray], jnp.ndarray]):
+    """Serve UNIQUE ``ids`` through the cache; fetch misses via ``fetch``.
+
+    ``fetch(masked_ids) -> [M, d]`` gathers from the backing store with
+    the standard padding contract (negative id -> zero row); hits and
+    padding arrive pre-masked to -1, so the backing store is only
+    touched for true misses.  Returns ``(state', rows)`` with the
+    freshly fetched rows inserted and counters bumped.  jit-safe; thread
+    ``state`` through your scan carry / donated step arguments.
+
+    ``ids`` MUST be duplicate-free among its valid entries (route through
+    :func:`~glt_tpu.ops.unique.unique_first_occurrence` first — the dedup
+    gather already has) or resident rows may be double-inserted.
+    """
+    rows_hit, hit = cache_lookup(state, ids)
+    miss = (ids >= 0) & ~hit
+    fetched = fetch(jnp.where(miss, ids, -1))
+    rows = jnp.where(hit[:, None], rows_hit, fetched.astype(rows_hit.dtype))
+    state = cache_insert(state, ids, fetched, miss)
+    return state._replace(
+        hits=state.hits + jnp.sum(hit.astype(jnp.int32)),
+        misses=state.misses + jnp.sum(miss.astype(jnp.int32))), rows
+
+
+def cache_stats(state: FeatureCacheState) -> dict:
+    """Fetch counters to host (SYNC POINT — call outside timed regions)."""
+    import numpy as np
+
+    h = int(np.asarray(state.hits))
+    m = int(np.asarray(state.misses))
+    return {
+        "hits": h,
+        "misses": m,
+        "lookups": h + m,
+        "hit_rate": h / max(h + m, 1),
+        "capacity": state.capacity,
+        "resident": int(np.asarray(
+            jnp.sum((state.slot_ids[:-1] >= 0).astype(jnp.int32)))),
+    }

@@ -69,6 +69,25 @@ class NodeLoader:
         # batch.metadata, overflow edges are already masked).
         self.overflow_fallback = bool(overflow_fallback)
         self.overflow_batches = 0
+        self._autotune_row_gather()
+
+    def _autotune_row_gather(self) -> None:
+        """Warmup A/B of the row-gather kernel (XLA vs tiled-DMA Pallas)
+        for this loader's gather shape, memoized per (row width, batch,
+        dtype) — ``gather_rows(force='auto')`` then serves every
+        ``_collate_fn`` with the measured winner.  No-op off TPU and for
+        tiered/absent features (their gathers are host-side stages)."""
+        feat = self.data.get_node_feature() if self.data is not None else None
+        cap = getattr(self.sampler, "node_capacity", None)
+        if (feat is None or cap is None
+                or getattr(feat, "hot_count", 0) != getattr(feat, "size", -1)):
+            return
+        from ..ops.gather_pallas import autotune_gather_rows
+
+        # Spread probe ids across the table: a constant index would hit
+        # one cached row and flatter whichever path wins on latency.
+        probe = jnp.arange(int(cap), dtype=jnp.int32) % max(feat.size, 1)
+        autotune_gather_rows(feat.hot_rows, probe)
 
     def __len__(self) -> int:
         n = self.input_nodes.shape[0]

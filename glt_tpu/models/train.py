@@ -68,22 +68,37 @@ def make_train_step(model, tx, batch_size: int,
     return train_step
 
 
-def make_gather_xy(id2index=None):
+def make_gather_xy(id2index=None, dedup: bool = False,
+                   force: str = "auto"):
     """Pure ``(rows, labels, out) -> (x, y)`` batch gather.
 
     Feature rows and labels ride as arguments (not closures) so callers
     can jit without re-marshalling GB-scale captured arrays; ``id2index``
     (the hotness-reorder indirection) applies to feature ROWS only —
     labels stay indexed by global id.
+
+    ``dedup=True`` fetches each unique row from HBM once and scatters it
+    back to every batch position (bit-identical ``x``; see
+    :func:`~glt_tpu.ops.dedup_gather.dedup_gather_rows`) — the win when
+    the node list repeats ids (un-deduped leaf hops, hub nodes).
+    ``force`` selects the row-gather kernel
+    (:func:`~glt_tpu.ops.gather_pallas.gather_rows`).
     """
+    from ..ops.dedup_gather import dedup_gather_rows
+    from ..ops.gather_pallas import gather_rows
+
     def gather_xy(rows_arg, labels_arg, out):
         ids = out.node
         valid = ids >= 0
         gid = jnp.where(valid, ids, 0)
-        ridx = (gid if id2index is None
-                else jnp.take(id2index, gid, axis=0, mode="clip"))
-        x = jnp.take(rows_arg, ridx, axis=0, mode="clip")
-        x = jnp.where(valid[:, None], x, 0)
+        if dedup:
+            x = dedup_gather_rows(rows_arg, ids, id2index=id2index,
+                                  force=force)
+        else:
+            ridx = (gid if id2index is None
+                    else jnp.take(id2index, gid, axis=0, mode="clip"))
+            x = gather_rows(rows_arg, ridx, force=force)
+            x = jnp.where(valid[:, None], x, 0)
         y = jnp.where(valid,
                       jnp.take(labels_arg, gid, axis=0, mode="clip"),
                       PADDING_ID)
@@ -92,8 +107,63 @@ def make_gather_xy(id2index=None):
     return gather_xy
 
 
+def make_cached_gather_xy(id2index=None, force: str = "auto"):
+    """Dedup + cross-batch-cache batch gather:
+    ``(cache, rows, labels, out) -> (cache, x, y)``.
+
+    The node list is routed through one unique pass; unique ids are
+    served by the :mod:`~glt_tpu.data.feature_cache` (hits from the HBM
+    cache table, misses fetched from ``rows`` and inserted), then rows
+    scatter back to every batch position — ``x`` is bit-identical to
+    :func:`make_gather_xy`'s as long as ``rows`` is unchanged.  The
+    returned cache must be threaded into the next call (scan carry /
+    donated step argument).
+    """
+    from ..data.feature_cache import cache_gather
+    from ..ops.gather_pallas import gather_rows
+    from ..ops.unique import unique_first_occurrence
+
+    def gather_xy(cache, rows_arg, labels_arg, out):
+        ids = out.node.astype(jnp.int32)
+        uniq, inv, _ = unique_first_occurrence(ids)
+
+        def fetch(fids):
+            v = fids >= 0
+            fidx = jnp.where(v, fids, 0)
+            if id2index is not None:
+                fidx = jnp.take(id2index, fidx, axis=0, mode="clip")
+            return jnp.where(v[:, None],
+                             gather_rows(rows_arg, fidx, force), 0)
+
+        cache, urows = cache_gather(cache, uniq, fetch)
+        x = jnp.take(urows, jnp.clip(inv, 0, inv.shape[0] - 1), axis=0)
+        x = jnp.where((inv >= 0)[:, None], x, 0)
+        valid = ids >= 0
+        gid = jnp.where(valid, ids, 0)
+        y = jnp.where(valid,
+                      jnp.take(labels_arg, gid, axis=0, mode="clip"),
+                      PADDING_ID)
+        return cache, x, y
+
+    return gather_xy
+
+
+def _check_cache(feature_cache, rows_dtype, dim):
+    """The cache table's dtype/width must match the feature rows, or the
+    cached-path ``x`` would silently change dtype vs the naive path."""
+    if feature_cache.table.dtype != rows_dtype:
+        raise ValueError(
+            f"feature_cache dtype {feature_cache.table.dtype} != feature "
+            f"rows dtype {rows_dtype}; build it with cache_init(..., "
+            f"dtype=rows.dtype)")
+    if feature_cache.dim != dim:
+        raise ValueError(
+            f"feature_cache dim {feature_cache.dim} != feature dim {dim}")
+
+
 def make_pipelined_train_step(model, tx, sampler, rows, labels,
-                              batch_size: int, dropout_seed: int = 0):
+                              batch_size: int, dropout_seed: int = 0,
+                              dedup: bool = False, feature_cache=None):
     """Fuse "train batch k" with "sample batch k+1" into ONE XLA program.
 
     The reference hides sampling latency behind training with up to 32
@@ -119,6 +189,15 @@ def make_pipelined_train_step(model, tx, sampler, rows, labels,
         out_k1)`` — one fused program; pass ``seeds_k1=None``'s stand-in
         (any batch, e.g. the first) for the epilogue call and drop its
         ``out``.
+
+    ``dedup=True`` switches the in-jit feature gather to the dedup-aware
+    path (bit-identical ``x``).  ``feature_cache`` (a
+    :class:`~glt_tpu.data.feature_cache.FeatureCacheState` built with the
+    rows' dtype/width) additionally serves unique ids through the
+    cross-batch HBM cache; the state is threaded through the step
+    internally (its buffers are DONATED — the object passed in is
+    invalid after the first call; read the live one via
+    ``step.feature_cache()``).
     """
     import numpy as np
 
@@ -134,7 +213,10 @@ def make_pipelined_train_step(model, tx, sampler, rows, labels,
             "(split_ratio=1.0); use the tiered pipeline for host tiers")
     feature = rows
     hot_rows = feature.hot_rows
-    gather_xy = make_gather_xy(feature.id2index)
+    if feature_cache is not None:
+        _check_cache(feature_cache, hot_rows.dtype, hot_rows.shape[-1])
+        cached_xy = make_cached_gather_xy(feature.id2index)
+    gather_xy = make_gather_xy(feature.id2index, dedup=dedup)
 
     # Graph arrays ride as jit arguments (they may be host numpy or, on a
     # mesh, process-spanning global arrays — neither may be closed over).
@@ -144,17 +226,12 @@ def make_pipelined_train_step(model, tx, sampler, rows, labels,
         return sampler._sample_jit(g.indptr, g.indices, g.gather_edge_ids,
                                    jnp.asarray(seeds, jnp.int32), key)
 
-    # out_prev's buffers are dead after the train half: donate them so the
-    # next batch's SamplerOutput reuses the allocation.  Feature rows and
-    # labels ride as jit ARGUMENTS: closure-captured device arrays of this
-    # size would be re-marshalled per compile (and may not be closed over
-    # at all on a multi-host mesh).
-    @partial(jax.jit, donate_argnums=(6,))
-    def _step(indptr, indices, eids, rows_arg, labels_arg,
-              state: TrainState, out_prev, seeds_next, key_next):
-        out_next = sampler._sample_impl(indptr, indices, eids, seeds_next,
-                                        key_next)
-        x, y = gather_xy(rows_arg, labels_arg, out_prev)
+    def _train_half(rows_arg, labels_arg, state, out_prev, cache):
+        """Shared train half; ``cache`` is None or a FeatureCacheState."""
+        if cache is None:
+            x, y = gather_xy(rows_arg, labels_arg, out_prev)
+        else:
+            cache, x, y = cached_xy(cache, rows_arg, labels_arg, out_prev)
         edge_index = jnp.stack([out_prev.row, out_prev.col])
         rng = jax.random.fold_in(jax.random.PRNGKey(dropout_seed),
                                  state.step)
@@ -170,8 +247,35 @@ def make_pipelined_train_step(model, tx, sampler, rows, labels,
             state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        return (TrainState(params, opt_state, state.step + 1), loss, acc,
-                out_next)
+        return TrainState(params, opt_state, state.step + 1), loss, acc, cache
+
+    # out_prev's buffers are dead after the train half: donate them so the
+    # next batch's SamplerOutput reuses the allocation.  Feature rows and
+    # labels ride as jit ARGUMENTS: closure-captured device arrays of this
+    # size would be re-marshalled per compile (and may not be closed over
+    # at all on a multi-host mesh).
+    @partial(jax.jit, donate_argnums=(6,))
+    def _step(indptr, indices, eids, rows_arg, labels_arg,
+              state: TrainState, out_prev, seeds_next, key_next):
+        out_next = sampler._sample_impl(indptr, indices, eids, seeds_next,
+                                        key_next)
+        state, loss, acc, _ = _train_half(rows_arg, labels_arg, state,
+                                          out_prev, None)
+        return state, loss, acc, out_next
+
+    # Cache variant: the cache state rides (and is donated) alongside
+    # out_prev so the HBM table updates in place batch-to-batch.
+    @partial(jax.jit, donate_argnums=(6, 9))
+    def _step_cached(indptr, indices, eids, rows_arg, labels_arg,
+                     state: TrainState, out_prev, seeds_next, key_next,
+                     cache):
+        out_next = sampler._sample_impl(indptr, indices, eids, seeds_next,
+                                        key_next)
+        state, loss, acc, cache = _train_half(rows_arg, labels_arg, state,
+                                              out_prev, cache)
+        return state, loss, acc, out_next, cache
+
+    cache_holder = {"cache": feature_cache}
 
     def step(state: TrainState, out_prev, seeds_next, key_next):
         if out_prev.metadata is not None:
@@ -182,10 +286,18 @@ def make_pipelined_train_step(model, tx, sampler, rows, labels,
             import dataclasses as _dc
 
             out_prev = _dc.replace(out_prev, metadata=None)
-        return _step(g.indptr, g.indices, g.gather_edge_ids, hot_rows,
-                     labels, state, out_prev,
-                     jnp.asarray(seeds_next, jnp.int32), key_next)
+        args = (g.indptr, g.indices, g.gather_edge_ids, hot_rows,
+                labels, state, out_prev,
+                jnp.asarray(seeds_next, jnp.int32), key_next)
+        if cache_holder["cache"] is None:
+            return _step(*args)
+        state, loss, acc, out_next, cache_holder["cache"] = _step_cached(
+            *args, cache_holder["cache"])
+        return state, loss, acc, out_next
 
+    # Live cache accessor (None when no cache was attached): feed it to
+    # data.feature_cache.cache_stats for the hit/miss counters.
+    step.feature_cache = lambda: cache_holder["cache"]
     return step, sample_first
 
 
@@ -233,7 +345,8 @@ def run_pipelined_epoch(step, sample_first, seed_batches, state,
 
 
 def make_scanned_node_train_step(model, tx, sampler, rows, labels,
-                                 batch_size: int, dropout_seed: int = 0):
+                                 batch_size: int, dropout_seed: int = 0,
+                                 dedup: bool = False, feature_cache=None):
     """ONE jitted program trains ``G`` consecutive seed-node batches.
 
     The supervised-node analog of :func:`make_scanned_link_train_step`:
@@ -252,6 +365,11 @@ def make_scanned_node_train_step(model, tx, sampler, rows, labels,
     samplers) — with a capped sampler, overflowed batches train with
     their excess-node edges masked; monitor the flags and re-run hot
     batches at full capacity (or raise the cap) if the rate matters.
+
+    ``dedup=True`` switches the in-scan feature gather to the dedup-aware
+    path; ``feature_cache`` threads a cross-batch HBM cache through the
+    scan carry AND across blocks (buffers donated — read the live state
+    via ``step.feature_cache()``).  Both leave ``x`` bit-identical.
     """
     import numpy as np
 
@@ -264,16 +382,22 @@ def make_scanned_node_train_step(model, tx, sampler, rows, labels,
     if rows.hot_count < rows.size:
         raise ValueError("scanned node step needs device-resident rows")
     hot_rows = rows.hot_rows
-    gather_xy = make_gather_xy(rows.id2index)
+    if feature_cache is not None:
+        _check_cache(feature_cache, hot_rows.dtype, hot_rows.shape[-1])
+        cached_xy = make_cached_gather_xy(rows.id2index)
+    gather_xy = make_gather_xy(rows.id2index, dedup=dedup)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(6,))
     def run(indptr, indices, eids, rows_arg, labels_arg,
-            state: TrainState, seeds_blk, key):
+            state: TrainState, cache, seeds_blk, key):
         def body(carry, inp):
-            st = carry
+            st, cache = carry
             seeds, k = inp
             out = sampler._sample_impl(indptr, indices, eids, seeds, k)
-            x, y = gather_xy(rows_arg, labels_arg, out)
+            if cache is None:
+                x, y = gather_xy(rows_arg, labels_arg, out)
+            else:
+                cache, x, y = cached_xy(cache, rows_arg, labels_arg, out)
             edge_index = jnp.stack([out.row, out.col])
             rng = jax.random.fold_in(jax.random.PRNGKey(dropout_seed),
                                      st.step)
@@ -302,17 +426,23 @@ def make_scanned_node_train_step(model, tx, sampler, rows, labels,
             st = jax.lax.cond(jnp.any(seeds >= 0), apply, lambda s: s, st)
             ovf = (out.metadata["overflow"].astype(jnp.int32)
                    if out.metadata else jnp.zeros((), jnp.int32))
-            return st, (loss, acc, ovf)
+            return (st, cache), (loss, acc, ovf)
 
         keys = jax.random.split(key, seeds_blk.shape[0])
-        state, (losses, accs, ovfs) = jax.lax.scan(body, state,
-                                                   (seeds_blk, keys))
-        return state, losses, accs, ovfs
+        (state, cache), (losses, accs, ovfs) = jax.lax.scan(
+            body, (state, cache), (seeds_blk, keys))
+        return state, cache, losses, accs, ovfs
+
+    cache_holder = {"cache": feature_cache}
 
     def step(state: TrainState, seeds_blk, key):
-        return run(g.indptr, g.indices, g.gather_edge_ids, hot_rows,
-                   labels, state, jnp.asarray(seeds_blk, jnp.int32), key)
+        state, cache_holder["cache"], losses, accs, ovfs = run(
+            g.indptr, g.indices, g.gather_edge_ids, hot_rows,
+            labels, state, cache_holder["cache"],
+            jnp.asarray(seeds_blk, jnp.int32), key)
+        return state, losses, accs, ovfs
 
+    step.feature_cache = lambda: cache_holder["cache"]
     return step
 
 

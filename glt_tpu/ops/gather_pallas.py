@@ -1,25 +1,42 @@
-"""Pallas row-gather kernel: the feature-lookup hot op.
+"""Pallas row-gather kernels: the feature-lookup hot op.
 
 TPU counterpart of the reference's ``GatherTensorKernel``
 (csrc/cuda/unified_tensor.cu:48-81): there, one warp copies each requested
-row from GPU/peer/pinned-host memory.  Here each grid step issues
-per-row **async DMAs from HBM into the VMEM output block** with the index
-list scalar-prefetched into SMEM (so row addresses are known before the
-body runs), overlapping up to ``LAG`` row copies — the DMA-pipelined
-equivalent of the warp-per-row design.
+row from GPU/peer/pinned-host memory.
 
-**Measured honestly (round 3, device-synced timing), XLA's native gather
-beats this kernel ~2x at 512B rows** (4.6 vs 9.8 ms per 102400-row
-gather on the v5-lite chip): the per-row DMA issue rate, even with
-``_LAG``-deep pipelining, loses to the hardware gather unit.  Round 1's
-"+15%" for this kernel was an artifact of ``block_until_ready`` not
-actually waiting under the axon tunnel (see bench.py).  ``gather_rows``
-therefore defaults to ``jnp.take``; the kernel stays available via
-``force='pallas'`` as the seam for future multi-stream DMA work.
+Two generations of kernel live here:
+
+* **round 3 (retired design, kept as the lesson):** one async DMA per
+  requested row, ``_LAG``-deep pipelined.  Measured honestly (device-synced
+  timing) XLA's native gather beat it ~2x at 512B rows (4.6 vs 9.8 ms per
+  102400-row gather on the v5-lite chip): per-row DMAs are **issue-rate
+  bound**, not bandwidth bound — the bench's ``est_hbm_fraction`` of 0.0005
+  says the gather path moves <0.1% of HBM peak, so issuing the same number
+  of DMAs faster was never going to win.
+
+* **tiled (current):** the win is in **coalescing**, not issue rate.  The
+  index list is sorted (XLA prologue), mapped onto aligned ``_TILE``-row
+  blocks of the table, and each *distinct* block is fetched with ONE
+  block DMA into a ``_NBUF``-deep ring of VMEM tile buffers (double
+  buffering generalised to ``_NBUF`` slots, ``_NBUF - 1`` DMAs in flight
+  while rows of the current tile are copied out).  Rows are emitted in
+  sorted order and un-permuted by an XLA epilogue gather.  Hotness-ordered
+  feature stores (:func:`~glt_tpu.data.reorder.sort_by_in_degree`) cluster
+  a batch's unique ids near the head of the table, so sorted runs share
+  tiles and one 4-16KB DMA serves many rows — the DMA count drops by the
+  clustering factor and each DMA is deep enough to stream.
+
+``gather_rows(force='auto')`` stays the A/B seam: it consults a per-(row
+width, batch, dtype) decision table filled by :func:`autotune_gather_rows`
+at warmup (eager, fetch-synced timing — ``block_until_ready`` lies under
+the axon tunnel, see bench.py) and falls back to XLA's gather wherever the
+kernel's shape constraints don't hold or no measurement exists.
 """
 from __future__ import annotations
 
 import functools
+import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -27,81 +44,208 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Rows in flight per grid step; also the semaphore-array width.
-_LAG = 8
-_CHUNK = 256  # rows per grid step
+_TILE = 8     # table rows per block DMA (8 x 512B = 4KB at d=128 f32)
+_CHUNK = 256  # output rows per grid step
+_NBUF = 8     # VMEM tile buffers == max DMAs in flight
+
+# Decision table for force='auto': (d, b, dtype) -> 'xla' | 'pallas',
+# filled by autotune_gather_rows (eager warmup only — a traced call can
+# not time anything, it just reads this table).
+_AUTO: dict = {}
 
 
-def _gather_kernel(idx_ref, table_ref, out_ref, sems):
-    i = pl.program_id(0)
-    n = table_ref.shape[0]
+def _plan_tiled(idx: jnp.ndarray, n: int):
+    """XLA prologue: sort ids and coalesce them into aligned tile DMAs.
 
-    def row_dma(r):
-        gid = idx_ref[i * _CHUNK + r]
-        gid = jnp.clip(gid, 0, n - 1)
+    Returns static-shape descriptor arrays for :func:`gather_rows_pallas`:
+      order     [B]  sorted position -> original position
+      dstart    [G, _CHUNK] first table row of each DMA (-chunk-local slot)
+      row_lo/hi [G, _CHUNK] chunk-relative sorted-row range served per DMA
+      ndma      [G]  live DMA count per chunk
+      off       [B]  row offset of each sorted row inside its tile
+    """
+    b = idx.shape[0]
+    nchunk = b // _CHUNK
+    idx = jnp.clip(idx.astype(jnp.int32), 0, n - 1)
+    order = jnp.argsort(idx, stable=True)
+    sidx = idx[order]
+    # Aligned tiles, clamped so the block DMA never overruns the table.
+    dstart_row = jnp.clip((sidx // _TILE) * _TILE, 0, n - _TILE)
+    off = (sidx - dstart_row).astype(jnp.int32)
+
+    r = jnp.arange(b, dtype=jnp.int32)
+    rel = r % _CHUNK
+    chunk = r // _CHUNK
+    prev = jnp.concatenate(
+        [jnp.full((1,), -1, dstart_row.dtype), dstart_row[:-1]])
+    # A new DMA starts at every distinct tile and at every chunk boundary
+    # (a tile straddling two chunks is fetched once per chunk).
+    head = (dstart_row != prev) | (rel == 0)
+    gidx = jnp.cumsum(head.astype(jnp.int32)) - 1
+    first = gidx[0::_CHUNK]                       # [G]
+    dma_j = gidx - first[chunk]                   # [B], in [0, _CHUNK)
+    ndma = gidx[_CHUNK - 1::_CHUNK] - first + 1   # [G]
+
+    # Scatter per-DMA descriptors; non-head rows land in an overflow
+    # column that is sliced off.
+    col = jnp.where(head, dma_j, _CHUNK)
+    dstart = (jnp.zeros((nchunk, _CHUNK + 1), jnp.int32)
+              .at[chunk, col].set(dstart_row)[:, :_CHUNK])
+    row_lo = (jnp.full((nchunk, _CHUNK + 1), _CHUNK, jnp.int32)
+              .at[chunk, col].set(rel)[:, :_CHUNK])
+    row_hi = jnp.concatenate(
+        [row_lo[:, 1:], jnp.full((nchunk, 1), _CHUNK, jnp.int32)], axis=1)
+    return order, dstart, row_lo, row_hi, ndma, off
+
+
+def _tiled_kernel(dstart_ref, row_lo_ref, row_hi_ref, ndma_ref, off_ref,
+                  table_ref, out_ref, tiles, sems):
+    c = pl.program_id(0)
+    nd = ndma_ref[c]
+
+    def dma(j):
+        slot = lax.rem(j, _NBUF)
+        start = dstart_ref[c, j]
         return pltpu.make_async_copy(
-            table_ref.at[gid], out_ref.at[r], sems.at[r % _LAG])
+            table_ref.at[pl.ds(start, _TILE)], tiles.at[slot],
+            sems.at[slot])
 
-    def body(r, _):
-        # Wait for the DMA LAG rows back (same semaphore slot) before
-        # reusing its semaphore for row r.
-        @pl.when(r >= _LAG)
+    # Fill the pipeline: up to _NBUF block DMAs in flight.
+    for k in range(_NBUF):
+        @pl.when(k < nd)
         def _():
-            row_dma(r - _LAG).wait()
-        row_dma(r).start()
+            dma(k).start()
+
+    def body(j, _):
+        slot = lax.rem(j, _NBUF)
+        dma(j).wait()
+        lo = row_lo_ref[c, j]
+        hi = row_hi_ref[c, j]
+
+        def copy_row(s, _):
+            o = off_ref[c * _CHUNK + s]
+            row = pl.load(tiles, (slot, pl.ds(o, 1), slice(None)))
+            pl.store(out_ref, (pl.ds(s, 1), slice(None)), row)
+            return _
+
+        lax.fori_loop(lo, hi, copy_row, None)
+        # Only after this tile's rows are consumed may its buffer slot be
+        # reissued (slot j % _NBUF == slot (j + _NBUF) % _NBUF).
+        @pl.when(j + _NBUF < nd)
+        def _():
+            dma(j + _NBUF).start()
         return _
 
-    lax.fori_loop(0, _CHUNK, body, None)
-
-    def drain(r, _):
-        row_dma(r).wait()
-        return _
-
-    lax.fori_loop(_CHUNK - _LAG, _CHUNK, drain, None)
+    lax.fori_loop(0, nd, body, None)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def gather_rows_pallas(table: jnp.ndarray, idx: jnp.ndarray,
                        interpret: bool = False) -> jnp.ndarray:
-    """Gather ``table[idx]`` via DMA pipelining.
+    """Gather ``table[idx]`` via coalesced block DMAs.
 
     Args:
-      table: ``[N, d]`` feature matrix (HBM-resident).
+      table: ``[N, d]`` feature matrix (HBM-resident), ``N >= 8``,
+        ``d % 128 == 0``.
       idx: ``[B]`` int32 row ids; out-of-range/negative ids are clamped
-        (callers mask padding rows).
-    Requires ``B % 256 == 0`` and ``d % 128 == 0`` (pad first).
+        (callers mask padding rows).  ``B`` is padded internally to a
+        multiple of 256.
     """
     b = idx.shape[0]
-    d = table.shape[1]
-    if b % _CHUNK != 0:
-        raise ValueError(f"batch {b} must be a multiple of {_CHUNK}")
+    n, d = table.shape
     if d % 128 != 0:
         raise ValueError(f"dim {d} must be a multiple of 128")
+    if n < _TILE:
+        raise ValueError(f"table rows {n} must be >= {_TILE}")
+    bp = -(-b // _CHUNK) * _CHUNK
+    idx_p = jnp.concatenate(
+        [idx.astype(jnp.int32), jnp.zeros((bp - b,), jnp.int32)])
 
+    order, dstart, row_lo, row_hi, ndma, off = _plan_tiled(idx_p, n)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(b // _CHUNK,),
+        num_scalar_prefetch=5,
+        grid=(bp // _CHUNK,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
-        out_specs=pl.BlockSpec((_CHUNK, d), lambda i, idx_ref: (i, 0),
+        out_specs=pl.BlockSpec((_CHUNK, d), lambda c, *_: (c, 0),
                                memory_space=pltpu.VMEM),
-        scratch_shapes=[pltpu.SemaphoreType.DMA((_LAG,))],
+        scratch_shapes=[
+            pltpu.VMEM((_NBUF, _TILE, d), table.dtype),
+            pltpu.SemaphoreType.DMA((_NBUF,)),
+        ],
     )
-    return pl.pallas_call(
-        _gather_kernel,
-        out_shape=jax.ShapeDtypeStruct((b, d), table.dtype),
+    sorted_out = pl.pallas_call(
+        _tiled_kernel,
+        out_shape=jax.ShapeDtypeStruct((bp, d), table.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(idx.astype(jnp.int32), table)
+    )(dstart, row_lo, row_hi, ndma, off, table)
+
+    # Un-permute: sorted row k belongs at original position order[k].
+    inv = (jnp.zeros((bp,), jnp.int32)
+           .at[order].set(jnp.arange(bp, dtype=jnp.int32)))
+    return jnp.take(sorted_out, inv[:b], axis=0)
+
+
+def _xla_gather(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    return jnp.take(table, jnp.clip(idx, 0, table.shape[0] - 1), axis=0)
+
+
+def pallas_gather_supported(table, idx) -> bool:
+    """Shape constraints of the tiled kernel (dtype-agnostic)."""
+    return table.shape[1] % 128 == 0 and table.shape[0] >= _TILE
+
+
+def _auto_key(table, idx):
+    return (int(table.shape[1]), int(idx.shape[0]), str(table.dtype))
+
+
+def autotune_gather_rows(table: jnp.ndarray, idx: jnp.ndarray,
+                         iters: int = 3) -> str:
+    """Measure XLA vs the tiled kernel for this (row width, batch, dtype)
+    and memoize the winner for ``gather_rows(force='auto')``.
+
+    Call EAGERLY at warmup (loader construction / bench setup) — never
+    from inside a trace.  Timing is fetch-synced (a host scalar fetch is
+    the only sync that provably waits under the axon tunnel; see
+    bench.py).  Off-TPU backends and unsupported shapes pin 'xla'.
+    """
+    key = _auto_key(table, idx)
+    if key in _AUTO:
+        return _AUTO[key]
+    choice = "xla"
+    if (jax.default_backend() == "tpu"
+            and pallas_gather_supported(table, idx)):
+        try:
+            def timed(fn):
+                float(fn(table, idx)[0, 0])  # compile + warm
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = fn(table, idx)
+                float(out[0, 0])             # fetch = true sync
+                return time.perf_counter() - t0
+
+            t_xla = timed(_xla_gather)
+            t_pal = timed(gather_rows_pallas)
+            choice = "pallas" if t_pal < t_xla else "xla"
+        except Exception:  # pragma: no cover - kernel unsupported on chip
+            choice = "xla"
+    _AUTO[key] = choice
+    return choice
 
 
 def gather_rows(table: jnp.ndarray, idx: jnp.ndarray,
                 force: str = "auto") -> jnp.ndarray:
     """Gather rows, choosing the best implementation.
 
-    force: 'auto' | 'pallas' | 'xla'.
+    force: 'auto' | 'pallas' | 'xla'.  'auto' reads the decision table
+    filled by :func:`autotune_gather_rows` (XLA until a measurement
+    exists).  The ``GLT_GATHER_FORCE`` env var overrides ``force``.
     """
-    # 'auto' = XLA take: measured 2x faster than the DMA kernel at 512B
-    # rows with honest device-synced timing (module docstring).
+    env = os.environ.get("GLT_GATHER_FORCE")
+    if env in ("pallas", "xla"):
+        force = env
     if force == "pallas":
         return gather_rows_pallas(table, idx)
-    return jnp.take(table, jnp.clip(idx, 0, table.shape[0] - 1), axis=0)
+    if force == "auto" and _AUTO.get(_auto_key(table, idx)) == "pallas":
+        return gather_rows_pallas(table, idx)
+    return _xla_gather(table, idx)

@@ -31,7 +31,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.unique import unique_first_occurrence
 from .dist_sampler import _bucket_by_owner
+
+
+def _dedup_scatter_back(urows: jnp.ndarray, inv: jnp.ndarray) -> jnp.ndarray:
+    """Expand unique-id rows back to every original position (-1 = pad)."""
+    out = jnp.take(urows, jnp.clip(inv, 0, inv.shape[0] - 1), axis=0)
+    return jnp.where((inv >= 0)[:, None], out, 0)
 
 
 def exchange_gather(
@@ -40,15 +47,25 @@ def exchange_gather(
     nodes_per_shard: int,
     num_shards: int,
     axis_name: str,
+    dedup: bool = False,
 ) -> jnp.ndarray:
     """Gather feature rows for global ``ids`` across shards.
 
     Call inside ``shard_map``. Args:
       ids: ``[B]`` global node ids on this shard (-1 padded -> zero rows).
       rows: ``[nodes_per_shard, d]`` this shard's feature block.
+      dedup: route UNIQUE ids through the exchange and scatter rows back
+        to every original position — duplicated ids (un-deduped leaf
+        hops, hub nodes) cross the ICI once instead of once per
+        occurrence.  Output is bit-identical to ``dedup=False``.
 
     Returns: ``[B, d]`` rows in input order.
     """
+    if dedup:
+        uniq, inv, _ = unique_first_occurrence(ids)
+        urows = exchange_gather(uniq, rows, nodes_per_shard, num_shards,
+                                axis_name)
+        return _dedup_scatter_back(urows, inv)
     b = ids.shape[0]
     d = rows.shape[-1]
     owner = jnp.where(ids >= 0, ids // nodes_per_shard, -1)
@@ -127,6 +144,7 @@ def exchange_gather_hot(
     staged_resp: Optional[jnp.ndarray] = None,
     staged_rows: Optional[jnp.ndarray] = None,
     staged_slots: Optional[jnp.ndarray] = None,
+    dedup: bool = False,
 ) -> jnp.ndarray:
     """Tiered gather; call inside ``shard_map``.
 
@@ -150,7 +168,19 @@ def exchange_gather_hot(
 
     Without either, cold rows come back as zeros (fill them via the
     legacy :func:`merge_cold` overlay).
+
+    ``dedup`` routes unique ids only (see :func:`exchange_gather`); the
+    staged cold rows must then come from a :func:`route_cold_requests`
+    call made with the SAME ``dedup`` flag, or slot indices won't line
+    up with the deduped request layout.
     """
+    if dedup:
+        uniq, inv, _ = unique_first_occurrence(ids)
+        urows = exchange_gather_hot(
+            uniq, hot_rows, nodes_per_shard, hot_per_shard, num_shards,
+            axis_name, staged_resp=staged_resp, staged_rows=staged_rows,
+            staged_slots=staged_slots)
+        return _dedup_scatter_back(urows, inv)
     b = ids.shape[0]
     d = hot_rows.shape[-1]
     owner = jnp.where(ids >= 0, ids // nodes_per_shard, -1)
@@ -213,6 +243,7 @@ def route_cold_requests(
     hot_per_shard: int,
     num_shards: int,
     axis_name: str,
+    dedup: bool = False,
 ) -> jnp.ndarray:
     """Responder-side cold request slots; call inside ``shard_map``.
 
@@ -221,8 +252,12 @@ def route_cold_requests(
     cold row index (``0..c-h``) of every incoming request slot, or -1
     for hot/foreign/padding slots: ``[num_shards * b]``.  The host then
     gathers exactly these rows from its local cold store — no host ever
-    touches another host's rows.
+    touches another host's rows.  Pass the same ``dedup`` flag as the
+    paired :func:`exchange_gather_hot` call (the request layout is
+    computed over the deduped id list).
     """
+    if dedup:
+        ids = unique_first_occurrence(ids).uniques
     b = ids.shape[0]
     owner = jnp.where(ids >= 0, ids // nodes_per_shard, -1)
     routing = _bucket_by_owner(ids, owner, num_shards, cap=b)

@@ -25,9 +25,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.train import TrainState, seed_cross_entropy
 from ..typing import PADDING_ID
+from ..ops.unique import unique_first_occurrence
 from .dist_feature import (
     TieredShardedFeature,
     HostColdStore,
+    _dedup_scatter_back,
     exchange_gather,
     exchange_gather_hot,
     route_cold_requests,
@@ -49,6 +51,7 @@ def make_dist_train_step(
     frontier_cap: Optional[int] = None,
     last_hop_dedup: bool = True,
     exchange_load_factor: Optional[float] = None,
+    dedup_gather: bool = False,
 ):
     """Build ``step(state, seeds [S, B], key) -> (state, loss, acc)``.
 
@@ -60,6 +63,10 @@ def make_dist_train_step(
     compact interior prefix, so the objective is unchanged.
     ``exchange_load_factor`` bounds the sampler's all-to-all buckets (see
     :func:`~glt_tpu.parallel.dist_sampler.dist_sample_multi_hop`).
+    ``dedup_gather`` routes unique node ids through the feature/label
+    exchange (one unique pass shared by both) and scatters rows back —
+    bit-identical batches, duplicated ids cross the ICI once; pair it
+    with ``last_hop_dedup=False``, whose leaf blocks repeat hub nodes.
     """
     gspec = P(axis_name)
 
@@ -74,10 +81,24 @@ def make_dist_train_step(
             g.nodes_per_shard, g.num_shards, axis_name, frontier_cap,
             last_hop_dedup=last_hop_dedup,
             exchange_load_factor=exchange_load_factor)
-        x = exchange_gather(out.node, rows, f.nodes_per_shard,
-                            f.num_shards, axis_name)
-        y = exchange_gather(out.node, labels_blk[:, None].astype(jnp.int32),
-                            g.nodes_per_shard, g.num_shards, axis_name)[:, 0]
+        if dedup_gather:
+            # ONE unique pass feeds both exchanges; rows/labels scatter
+            # back to every original position (bit-identical batch).
+            uniq, inv, _ = unique_first_occurrence(out.node)
+            x = _dedup_scatter_back(
+                exchange_gather(uniq, rows, f.nodes_per_shard,
+                                f.num_shards, axis_name), inv)
+            y = _dedup_scatter_back(
+                exchange_gather(uniq, labels_blk[:, None].astype(jnp.int32),
+                                g.nodes_per_shard, g.num_shards, axis_name),
+                inv)[:, 0]
+        else:
+            x = exchange_gather(out.node, rows, f.nodes_per_shard,
+                                f.num_shards, axis_name)
+            y = exchange_gather(out.node,
+                                labels_blk[:, None].astype(jnp.int32),
+                                g.nodes_per_shard, g.num_shards,
+                                axis_name)[:, 0]
         y = jnp.where(out.node >= 0, y, PADDING_ID)
         edge_index = jnp.stack([out.row, out.col])
 
@@ -128,6 +149,7 @@ def make_tiered_train_step(
     mesh: Mesh,
     batch_size: int,
     axis_name: str = "shard",
+    dedup_gather: bool = False,
 ):
     """Build the train half of the tiered two-stage pipeline.
 
@@ -142,6 +164,10 @@ def make_tiered_train_step(
     Hot rows ride the in-jit all-to-all; cold rows are scattered into the
     response leg — the per-row HBM/host split the reference's
     UnifiedTensor makes inside its gather kernel (unified_tensor.cu:48-81).
+
+    ``dedup_gather`` must match the :class:`TieredTrainPipeline`'s flag:
+    the staged cold rows are keyed to the (possibly deduped) request
+    layout.
     """
     gspec = P(axis_name)
 
@@ -155,9 +181,11 @@ def make_tiered_train_step(
         x = exchange_gather_hot(out.node, hot_rows, f.nodes_per_shard,
                                 f.hot_per_shard, f.num_shards, axis_name,
                                 staged_rows=staged_rows,
-                                staged_slots=staged_slots)
+                                staged_slots=staged_slots,
+                                dedup=dedup_gather)
         y = exchange_gather(out.node, labels_blk[:, None].astype(jnp.int32),
-                            g.nodes_per_shard, g.num_shards, axis_name)[:, 0]
+                            g.nodes_per_shard, g.num_shards, axis_name,
+                            dedup=dedup_gather)[:, 0]
         y = jnp.where(out.node >= 0, y, PADDING_ID)
         edge_index = jnp.stack([out.row, out.col])
 
@@ -361,7 +389,8 @@ class TieredTrainPipeline(_ColdStagePipeline):
                  axis_name: str = "shard",
                  cold_store: Optional[HostColdStore] = None,
                  cold_cap: Optional[int] = None,
-                 stage_threads: Optional[int] = None):
+                 stage_threads: Optional[int] = None,
+                 dedup_gather: bool = False):
         from . import multihost
         from .dist_feature import compact_cold_requests
 
@@ -397,9 +426,11 @@ class TieredTrainPipeline(_ColdStagePipeline):
         gspec = P(axis_name)
 
         def route_body(nodes):
+            # dedup_gather must match the train step's flag: the staged
+            # slots index the (possibly deduped) request layout.
             req = route_cold_requests(
                 nodes[0], f.nodes_per_shard, f.hot_per_shard,
-                f.num_shards, axis_name)
+                f.num_shards, axis_name, dedup=dedup_gather)
             slots, ids, dropped = compact_cold_requests(req, self.cold_cap)
             return slots[None], ids[None], dropped[None]
 

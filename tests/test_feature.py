@@ -64,6 +64,52 @@ class TestFeature:
         f = Feature(arr, split_ratio=0.5)
         np.testing.assert_array_equal(f.cpu_get(np.array([5, 0])), arr[[5, 0]])
 
+    def test_tiered_gather_many_splits_match_host(self):
+        """The tier-split merge (host gathers ONLY cold rows, device
+        scatters them back) must be exact at every split point."""
+        rng = np.random.default_rng(1)
+        arr = rng.normal(size=(40, 3)).astype(np.float32)
+        ids = np.array([0, 39, 17, -1, 5, 23, 39, -1, 8])
+        want = np.where((ids >= 0)[:, None], arr[np.clip(ids, 0, 39)], 0)
+        for ratio in (0.0, 0.1, 0.5, 0.9):
+            got = np.asarray(Feature(arr, split_ratio=ratio).gather(ids))
+            np.testing.assert_allclose(got, want, err_msg=f"ratio={ratio}")
+
+    def test_tiered_gather_all_hot_and_all_cold_batches(self):
+        arr = np.arange(60, dtype=np.float32).reshape(20, 3)
+        f = Feature(arr, split_ratio=0.5)   # rows 0-9 hot, 10-19 cold
+        np.testing.assert_allclose(
+            np.asarray(f.gather(np.array([0, 3, 9]))), arr[[0, 3, 9]])
+        np.testing.assert_allclose(
+            np.asarray(f.gather(np.array([10, 19, 15]))), arr[[10, 19, 15]])
+
+    def test_int64_id_overflow_raises(self):
+        """GLT004 regression: ids past int32 must raise, never silently
+        truncate into a wrong-row gather."""
+        import pytest
+
+        arr = np.ones((4, 2), np.float32)
+        for f in (Feature(arr, split_ratio=1.0),
+                  Feature(arr, split_ratio=0.5)):
+            with pytest.raises(OverflowError, match="int32"):
+                f.gather(np.array([2**31], np.int64))
+            with pytest.raises(OverflowError, match="int32"):
+                f.gather(np.array([-2**35], np.int64))
+            with pytest.raises(OverflowError, match="int32"):
+                f.cpu_get(np.array([0, 2**40], np.int64))
+            # in-range int64 values stay legal
+            np.testing.assert_allclose(
+                np.asarray(f.gather(np.array([1, 2], np.int64))),
+                arr[[1, 2]])
+
+    def test_dedup_feature_matches_naive(self):
+        rng = np.random.default_rng(2)
+        arr = rng.normal(size=(16, 3)).astype(np.float32)
+        ids = jnp.array([3, 3, -1, 9, 3, 0])
+        plain = np.asarray(Feature(arr).gather(ids))
+        dedup = np.asarray(Feature(arr, dedup=True).gather(ids))
+        np.testing.assert_array_equal(plain, dedup)
+
 
 class TestReorder:
     def test_hottest_first(self):

@@ -52,6 +52,46 @@ def test_unique_all_padding():
     assert (np.asarray(inv) == -1).all()
 
 
+def test_unique_all_duplicates():
+    """Single repeated id (the hub-node extreme the dedup gather relies
+    on): one unique, every valid position maps to slot 0."""
+    ids = jnp.array([7, 7, 7, 7, 7, 7], jnp.int32)
+    u, inv, cnt = unique_first_occurrence(ids)
+    assert int(cnt) == 1
+    assert np.asarray(u).tolist() == [7, -1, -1, -1, -1, -1]
+    assert np.asarray(inv).tolist() == [0] * 6
+
+
+def test_unique_all_duplicates_with_padding():
+    ids = jnp.array([-1, 5, 5, -1, 5], jnp.int32)
+    u, inv, cnt = unique_first_occurrence(ids)
+    assert int(cnt) == 1
+    assert np.asarray(u)[:1].tolist() == [5]
+    assert np.asarray(inv).tolist() == [-1, 0, 0, -1, 0]
+
+
+def test_unique_seeds_front_under_interleaved_padding():
+    """The loader invariant the dedup gather must preserve: seeds placed
+    first come out first IN ORDER even when padding holes interleave the
+    seed block and the neighbor tail repeats them."""
+    ids = jnp.array([9, -1, 4, -1, 7, 4, 11, -1, 9, 2], jnp.int32)
+    u, inv, cnt = unique_first_occurrence(ids)
+    assert np.asarray(u)[: int(cnt)].tolist() == [9, 4, 7, 11, 2]
+    # inverse of the padded seed slots is -1, of the dup tail the seed slot
+    assert int(inv[1]) == -1 and int(inv[5]) == 1 and int(inv[8]) == 0
+
+
+def test_unique_count_equals_capacity():
+    """All-distinct input: count == array capacity, no -1 slots, inverse
+    is the identity permutation over first occurrences."""
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(64).astype(np.int32)
+    u, inv, cnt = unique_first_occurrence(jnp.asarray(vals))
+    assert int(cnt) == 64
+    assert np.asarray(u).tolist() == vals.tolist()
+    assert np.asarray(inv).tolist() == list(range(64))
+
+
 def test_relabel_by_reference():
     ref = jnp.array([9, 4, 7, 11, 2, -1, -1], jnp.int32)
     q = jnp.array([7, 2, 9, -1, 11, 4], jnp.int32)
