@@ -81,6 +81,37 @@ _PARTIAL = {}
 _DONE = False
 
 
+def make_routing_only_fn(widths, node_cap, nodes_per_shard, num_shards,
+                         route="auto"):
+    """Jitted program running JUST the routing prologue one dist batch
+    pays: one ``build_routing`` per hop frontier plus the single shared
+    plan the fused feature+label gather builds over the node capacity.
+    Isolates ``dist_routing_ms`` from the exchange's sampling and
+    collective legs (build_routing is collective-free, so this runs
+    outside shard_map).  Also imported by the dist-path smoke test.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from glt_tpu.parallel.dist_sampler import build_routing
+
+    widths = [int(w) for w in widths]
+
+    @jax.jit
+    def fn(ids):
+        # Sums over every Routing field defeat dead-code elimination.
+        tot = jnp.zeros((), jnp.int32)
+        for w in widths:
+            r = build_routing(ids[:w], nodes_per_shard, num_shards,
+                              route=route)
+            tot = tot + r.buckets.sum() + r.slot.sum() + r.dropped
+        r = build_routing(ids[:node_cap], nodes_per_shard, num_shards,
+                          route=route)
+        return tot + r.buckets.sum() + r.slot.sum() + r.dropped
+
+    return fn
+
+
 def _watchdog(deadline_s: float) -> None:
     import threading
 
@@ -634,22 +665,68 @@ def main():
     # unsharded arrays makes every jitted call re-transfer the whole
     # graph + feature (measured: a 5 s/step artifact, not device time).
     sg = put_sharded(shard_graph(topo, 1), mesh1, "shard")
+    dseeds = [jnp.asarray(np.asarray(b).reshape(1, BATCH))
+              for b in batches]
+
+    def time_dist_sampler(ds):
+        o = ds.sample_from_nodes(dseeds[0])         # warm compile
+        tot = jnp.zeros((), jnp.int32)
+        tot = acc_edges(tot, o.num_sampled_edges)
+        sync(tot)
+        tot = jnp.zeros((), jnp.int32)
+        t0 = time.perf_counter()
+        for i in range(t_iters):
+            o = ds.sample_from_nodes(dseeds[(WARMUP + i) % len(dseeds)])
+            tot = acc_edges(tot, o.num_sampled_edges)
+        sync(tot)
+        return (time.perf_counter() - t0) / t_iters * 1e3
+
     dsampler = DistNeighborSampler(sg, mesh1, num_neighbors=FANOUT,
                                    batch_size=BATCH, frontier_cap=fcap,
                                    seed=0, exchange_load_factor=2.0)
-    dseeds = [jnp.asarray(np.asarray(b).reshape(1, BATCH))
-              for b in batches]
-    o = dsampler.sample_from_nodes(dseeds[0])       # warm compile
-    tot = jnp.zeros((), jnp.int32)
-    tot = acc_edges(tot, o.num_sampled_edges)
-    sync(tot)
-    tot = jnp.zeros((), jnp.int32)
+    dist_sample_ms = time_dist_sampler(dsampler)
+    dist_route_path = dsampler.route
+
+    # Routing A/B (ISSUE 3): the same program with each bucketing path
+    # forced — the device-side cost delta of the sort-free routing.
+    _progress("dist routing A/B (sort vs onepass)")
+    dist_sample_ms_ab = {}
+    for rp in ("sort", "onepass"):
+        dvar = DistNeighborSampler(sg, mesh1, num_neighbors=FANOUT,
+                                   batch_size=BATCH, frontier_cap=fcap,
+                                   seed=0, exchange_load_factor=2.0,
+                                   route=rp)
+        dist_sample_ms_ab[rp] = time_dist_sampler(dvar)
+
+    # Hop breakdown: routing prologue measured standalone (one
+    # build_routing per hop frontier + the shared gather plan), local
+    # sampling = the single-device sampler on the same shapes, and the
+    # collective/stitch residual.
+    _progress("dist hop breakdown (routing-only program)")
+    from glt_tpu.sampler.neighbor_sampler import hop_widths as _hop_widths
+
+    widths1 = _hop_widths(BATCH, FANOUT, fcap)
+    rfn = make_routing_only_fn(widths1, cap, sg.nodes_per_shard, 1,
+                               route=dist_route_path)
+    route_ids = jnp.asarray(
+        np.random.default_rng(3).integers(0, n, cap).astype(np.int32))
+    int(rfn(route_ids))   # warm compile + fetch sync
     t0 = time.perf_counter()
-    for i in range(t_iters):
-        o = dsampler.sample_from_nodes(dseeds[(WARMUP + i) % len(dseeds)])
-        tot = acc_edges(tot, o.num_sampled_edges)
-    sync(tot)
-    dist_sample_ms = (time.perf_counter() - t0) / t_iters * 1e3
+    for _ in range(t_iters):
+        rtot = rfn(route_ids)
+    int(rtot)
+    dist_routing_ms = (time.perf_counter() - t0) / t_iters * 1e3
+    dist_local_sample_ms = full["sample_ms"]
+    dist_collective_ms = max(
+        dist_sample_ms - dist_routing_ms - dist_local_sample_ms, 0.0)
+    _PARTIAL.update({
+        "dist_route_path": dist_route_path,
+        "dist_sample_ms_sort": round(dist_sample_ms_ab["sort"], 2),
+        "dist_sample_ms_onepass": round(dist_sample_ms_ab["onepass"], 2),
+        "dist_routing_ms": round(dist_routing_ms, 2),
+        "dist_local_sample_ms": round(dist_local_sample_ms, 2),
+        "dist_collective_ms": round(dist_collective_ms, 2),
+    })
 
     sf = put_sharded(shard_feature(np.asarray(feat.hot_rows), 1),
                      mesh1, "shard")
@@ -772,9 +849,19 @@ def main():
         "subgraphs_per_s": round(1e3 / best_step_ms, 1),
         # Distributed path on the real chip (1-device mesh: degenerate
         # collectives, so this isolates the routing machinery's device
-        # cost vs the single-device programs above).
+        # cost vs the single-device programs above).  The hop breakdown
+        # splits it: routing prologue (standalone build_routing program,
+        # A/B seam GLT_ROUTE_FORCE), local sampling (the single-device
+        # sampler at the same shapes), and the collective/stitch
+        # residual.
         "dist_sample_ms_tpu": round(dist_sample_ms, 2),
         "dist_step_ms_tpu": round(dist_step_ms, 2),
+        "dist_route_path": dist_route_path,
+        "dist_sample_ms_sort": round(dist_sample_ms_ab["sort"], 2),
+        "dist_sample_ms_onepass": round(dist_sample_ms_ab["onepass"], 2),
+        "dist_routing_ms": round(dist_routing_ms, 2),
+        "dist_local_sample_ms": round(dist_local_sample_ms, 2),
+        "dist_collective_ms": round(dist_collective_ms, 2),
         "dist_routing_overhead": round(
             dist_sample_ms / max(full["sample_ms"], 1e-9), 2),
         # MEASURED flagship epoch — same code path as the README headline

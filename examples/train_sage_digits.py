@@ -124,7 +124,8 @@ def main():
     for b in loader:
         _, acc = ev(state.params, b)
         accs.append(float(acc))
-    test_acc = float(np.mean(accs))
+        weights.append(b.batch_size)   # valid seeds (trailing batch < bs)
+    test_acc = float(np.average(accs, weights=weights))
     base = meta.get("baseline_acc", {})
     print(f"TEST accuracy: {test_acc:.4f}  "
           f"(baselines on same split: {base})")

@@ -114,9 +114,15 @@ class NodeLoader:
                     seeds = next(batches, None)
                     if seeds is None:
                         break
-                    pending.append(
-                        (self.sampler.sample_from_nodes(NodeSamplerInput(seeds)),
-                         seeds.shape[0]))
+                    out = self.sampler.sample_from_nodes(
+                        NodeSamplerInput(seeds))
+                    # Deferred-flag pattern (cf. run_pipelined_epoch):
+                    # start the flag's D2H copy at dispatch so the
+                    # strict check at pop time resolves a transfer that
+                    # overlapped the prefetch window instead of paying a
+                    # blocking round trip per batch.
+                    self._prime_overflow_flag(out)
+                    pending.append((out, seeds.shape[0]))
                 if not pending:
                     return
                 out, nseeds = pending.popleft()
@@ -125,19 +131,49 @@ class NodeLoader:
         finally:
             pending.clear()
 
+    def _overflow_checked(self) -> bool:
+        """Whether the strict overflow fallback is active for this loader."""
+        return (self.overflow_fallback
+                and bool(getattr(self.sampler, "capped", False)))
+
+    def _prime_overflow_flag(self, out) -> None:
+        """Async-fetch the overflow scalar of a freshly primed batch.
+
+        ``copy_to_host_async`` enqueues the device->host copy behind the
+        sample program; by the time the batch reaches the head of the
+        prefetch queue the scalar has usually landed, so the pop-time
+        check costs ~nothing when overflow never occurs (the blocking
+        per-batch ``device_get`` round trip was ADVICE r5's finding).
+        """
+        if not self._overflow_checked() or not out.metadata:
+            return
+        flag = out.metadata.get("overflow")
+        copy_async = getattr(flag, "copy_to_host_async", None)
+        if copy_async is not None:
+            try:
+                copy_async()
+            except Exception:  # pragma: no cover - backend w/o async copy
+                pass
+
     def _maybe_refetch_overflow(self, out):
         """Strict overflow fallback: re-sample a flagged batch through the
-        sampler's full-capacity twin (verbatim seeds from ``out.batch``)."""
-        s = self.sampler
-        if (not self.overflow_fallback or not getattr(s, "capped", False)
-                or not out.metadata):
+        sampler's full-capacity twin.
+
+        Only the SEEDS are verbatim (``out.batch``): the full-capacity
+        sibling draws with its own fresh RNG counter, so the refetched
+        batch is a NEW neighbor draw at full capacity — not the uncapped
+        replay of the flagged draw.  Fine for training (any exact draw
+        is as good as another); don't expect deterministic reproduction
+        of the flagged batch during eval/debugging.
+        """
+        if not self._overflow_checked() or not out.metadata:
             return out
         import jax
 
         if not bool(np.asarray(jax.device_get(out.metadata["overflow"]))):
             return out
         self.overflow_batches += 1
-        return s.full_capacity_sibling().sample_from_nodes(
+        return self.sampler.full_capacity_sibling().sample_from_nodes(
             NodeSamplerInput(out.batch))
 
     # -- collate (cf. node_loader.py:85 ``_collate_fn``) -------------------

@@ -5,9 +5,13 @@ reference masks ids through the feature partition book, gathers local rows
 from the UnifiedTensor, issues per-remote-partition async RPCs
 (``RpcFeatureLookupCallee``) and scatter-stitches responses into the output
 buffer.  Here the whole lookup is one collective round-trip: bucket ids by
-owner shard, ``all_to_all`` the id buckets, every shard gathers its rows
-from HBM, ``all_to_all`` the row blocks back, unscatter.  Payload rides ICI
-and overlaps with neighboring compute under XLA's scheduler.
+owner shard (a :func:`~glt_tpu.parallel.dist_sampler.build_routing` plan,
+reusable across exchanges), ``all_to_all`` the id buckets, every shard
+gathers its rows from HBM, ``all_to_all`` the row blocks back, unscatter.
+:func:`exchange_gather_xy` fuses the feature AND label lookup of a
+frontier into ONE such round-trip (labels bitcast into a float32 payload
+column — bit-exact).  Payload rides ICI and overlaps with neighboring
+compute under XLA's scheduler.
 
 **Host tiering** (:class:`TieredShardedFeature`): when the feature matrix
 exceeds mesh HBM (papers100M ≈ 200GB), each shard keeps only a hotness-
@@ -32,13 +36,29 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.unique import unique_first_occurrence
-from .dist_sampler import _bucket_by_owner
+from .dist_sampler import Routing, _use_fused, build_routing
 
 
 def _dedup_scatter_back(urows: jnp.ndarray, inv: jnp.ndarray) -> jnp.ndarray:
     """Expand unique-id rows back to every original position (-1 = pad)."""
     out = jnp.take(urows, jnp.clip(inv, 0, inv.shape[0] - 1), axis=0)
     return jnp.where((inv >= 0)[:, None], out, 0)
+
+
+def _dedup_scatter_back_1d(uvals: jnp.ndarray, inv: jnp.ndarray
+                           ) -> jnp.ndarray:
+    """1-D analog of :func:`_dedup_scatter_back` (label columns)."""
+    out = jnp.take(uvals, jnp.clip(inv, 0, inv.shape[0] - 1))
+    return jnp.where(inv >= 0, out, 0)
+
+
+def _exchange_ids(routing: Routing, num_shards: int, cap: int,
+                  axis_name: str) -> jnp.ndarray:
+    """The id request all-to-all of every exchange: row q of the result
+    holds the ids shard q wants from us."""
+    return lax.all_to_all(
+        routing.buckets.reshape(num_shards, cap), axis_name, 0, 0,
+        tiled=False).reshape(num_shards * cap)
 
 
 def exchange_gather(
@@ -48,6 +68,8 @@ def exchange_gather(
     num_shards: int,
     axis_name: str,
     dedup: bool = False,
+    routing: Optional[Routing] = None,
+    route: str = "auto",
 ) -> jnp.ndarray:
     """Gather feature rows for global ``ids`` across shards.
 
@@ -58,22 +80,25 @@ def exchange_gather(
         to every original position — duplicated ids (un-deduped leaf
         hops, hub nodes) cross the ICI once instead of once per
         occurrence.  Output is bit-identical to ``dedup=False``.
+      routing: pre-built plan for ``ids`` from
+        :func:`~glt_tpu.parallel.dist_sampler.build_routing` — reuse ONE
+        plan across the neighbor/feature/label exchanges of a frontier
+        instead of re-bucketing per exchange.  Ignored under ``dedup``
+        (the plan there is over the unique id list).
 
     Returns: ``[B, d]`` rows in input order.
     """
     if dedup:
         uniq, inv, _ = unique_first_occurrence(ids)
         urows = exchange_gather(uniq, rows, nodes_per_shard, num_shards,
-                                axis_name)
+                                axis_name, route=route)
         return _dedup_scatter_back(urows, inv)
     b = ids.shape[0]
     d = rows.shape[-1]
-    owner = jnp.where(ids >= 0, ids // nodes_per_shard, -1)
-    routing = _bucket_by_owner(ids, owner, num_shards, cap=b)
-
-    requests = lax.all_to_all(
-        routing.buckets.reshape(num_shards, b), axis_name, 0, 0,
-        tiled=False).reshape(num_shards * b)
+    if routing is None:
+        routing = build_routing(ids, nodes_per_shard, num_shards,
+                                route=route)
+    requests = _exchange_ids(routing, num_shards, b, axis_name)
 
     my_rank = lax.axis_index(axis_name)
     local = requests - my_rank * nodes_per_shard
@@ -145,6 +170,8 @@ def exchange_gather_hot(
     staged_rows: Optional[jnp.ndarray] = None,
     staged_slots: Optional[jnp.ndarray] = None,
     dedup: bool = False,
+    routing: Optional[Routing] = None,
+    route: str = "auto",
 ) -> jnp.ndarray:
     """Tiered gather; call inside ``shard_map``.
 
@@ -179,16 +206,14 @@ def exchange_gather_hot(
         urows = exchange_gather_hot(
             uniq, hot_rows, nodes_per_shard, hot_per_shard, num_shards,
             axis_name, staged_resp=staged_resp, staged_rows=staged_rows,
-            staged_slots=staged_slots)
+            staged_slots=staged_slots, route=route)
         return _dedup_scatter_back(urows, inv)
     b = ids.shape[0]
     d = hot_rows.shape[-1]
-    owner = jnp.where(ids >= 0, ids // nodes_per_shard, -1)
-    routing = _bucket_by_owner(ids, owner, num_shards, cap=b)
-
-    requests = lax.all_to_all(
-        routing.buckets.reshape(num_shards, b), axis_name, 0, 0,
-        tiled=False).reshape(num_shards * b)
+    if routing is None:
+        routing = build_routing(ids, nodes_per_shard, num_shards,
+                                route=route)
+    requests = _exchange_ids(routing, num_shards, b, axis_name)
 
     my_rank = lax.axis_index(axis_name)
     local = requests - my_rank * nodes_per_shard
@@ -212,6 +237,106 @@ def exchange_gather_hot(
         tiled=False).reshape(num_shards * b, d)
     out = resp[jnp.clip(routing.slot, 0, num_shards * b - 1)]
     return jnp.where(routing.valid[:, None], out, 0)
+
+
+def exchange_gather_xy(
+    ids: jnp.ndarray,
+    rows: jnp.ndarray,
+    labels_col: jnp.ndarray,
+    nodes_per_shard: int,
+    num_shards: int,
+    axis_name: str,
+    hot_per_shard: Optional[int] = None,
+    staged_rows: Optional[jnp.ndarray] = None,
+    staged_slots: Optional[jnp.ndarray] = None,
+    dedup: bool = False,
+    routing: Optional[Routing] = None,
+    route: str = "auto",
+    fused: Optional[bool] = None,
+):
+    """Feature AND label gather for one frontier in a single exchange.
+
+    Call inside ``shard_map``.  The pre-fusion train step ran this as two
+    (or, tiered, three) independent exchanges over the SAME ids — each
+    rebuilding the identical routing plan and launching its own id +
+    payload collectives.  Here one :func:`build_routing` plan, one id
+    all-to-all, and one fused payload all-to-all carry both: the serving
+    shard's int32 label column is **bitcast** to a float32 payload column
+    and concatenated onto the feature rows (pure data movement end to
+    end, so the round trip is bit-exact for ANY label value), then split
+    and bitcast back on the requester.  Halves the collective launches of
+    the gather stage and removes two redundant routing prologues.
+
+    Args:
+      ids: ``[B]`` global node ids (-1 padded -> zero rows/labels).
+      rows: ``[nodes_per_shard, d]`` (full) or hot-prefix feature block.
+      labels_col: ``[nodes_per_shard]`` this shard's label column.
+      hot_per_shard: tiered serving bound — requests past it take staged
+        cold rows (see :func:`exchange_gather_hot`); None = full HBM.
+      staged_rows / staged_slots: compact cold staging, as
+        :func:`exchange_gather_hot`.
+      dedup: unique ids ride the exchange once; scatter-back is
+        bit-identical (see :func:`exchange_gather`).
+      fused: collective-fusion seam; the split fallback still shares the
+        routing plan and id collective, paying one extra payload launch.
+        Value-fusion also requires a float32 feature block (the bitcast
+        target); other dtypes silently take the shared-routing split.
+
+    Returns:
+      ``(x [B, d], y [B] int32)`` in input order (zeros at invalid
+      slots, exactly like the separate exchanges).
+    """
+    if dedup:
+        uniq, inv, _ = unique_first_occurrence(ids)
+        ux, uy = exchange_gather_xy(
+            uniq, rows, labels_col, nodes_per_shard, num_shards,
+            axis_name, hot_per_shard=hot_per_shard,
+            staged_rows=staged_rows, staged_slots=staged_slots,
+            route=route, fused=fused)
+        return _dedup_scatter_back(ux, inv), _dedup_scatter_back_1d(uy, inv)
+
+    b = ids.shape[0]
+    d = rows.shape[-1]
+    if routing is None:
+        routing = build_routing(ids, nodes_per_shard, num_shards,
+                                route=route)
+    requests = _exchange_ids(routing, num_shards, b, axis_name)
+
+    my_rank = lax.axis_index(axis_name)
+    local = requests - my_rank * nodes_per_shard
+    h = nodes_per_shard if hot_per_shard is None else int(hot_per_shard)
+    okx = (local >= 0) & (local < h) & (requests >= 0)
+    oky = (local >= 0) & (local < nodes_per_shard) & (requests >= 0)
+    gotx = jnp.take(rows, jnp.where(okx, local, 0), axis=0, mode="clip")
+    gotx = jnp.where(okx[:, None], gotx, 0)
+    if staged_rows is not None:
+        idx = jnp.where(staged_slots >= 0, staged_slots, num_shards * b)
+        gotx = gotx.at[idx].set(staged_rows.astype(gotx.dtype),
+                                mode="drop")
+    goty = jnp.take(labels_col.astype(jnp.int32),
+                    jnp.where(oky, local, 0), mode="clip")
+    goty = jnp.where(oky, goty, 0)
+
+    if _use_fused(fused) and rows.dtype == jnp.float32:
+        ybits = lax.bitcast_convert_type(goty, jnp.float32)[:, None]
+        resp = lax.all_to_all(
+            jnp.concatenate([gotx, ybits], axis=-1)
+            .reshape(num_shards, b, d + 1), axis_name, 0, 0,
+            tiled=False).reshape(num_shards * b, d + 1)
+        respx = resp[:, :d]
+        respy = lax.bitcast_convert_type(resp[:, d], jnp.int32)
+    else:
+        respx = lax.all_to_all(
+            gotx.reshape(num_shards, b, d), axis_name, 0, 0,
+            tiled=False).reshape(num_shards * b, d)
+        respy = lax.all_to_all(
+            goty.reshape(num_shards, b), axis_name, 0, 0,
+            tiled=False).reshape(num_shards * b)
+
+    slot = jnp.clip(routing.slot, 0, num_shards * b - 1)
+    x = jnp.where(routing.valid[:, None], respx[slot], 0)
+    y = jnp.where(routing.valid, respy[slot], 0)
+    return x, y
 
 
 def compact_cold_requests(cold_req: jnp.ndarray, cold_cap: int):
@@ -244,6 +369,8 @@ def route_cold_requests(
     num_shards: int,
     axis_name: str,
     dedup: bool = False,
+    routing: Optional[Routing] = None,
+    route: str = "auto",
 ) -> jnp.ndarray:
     """Responder-side cold request slots; call inside ``shard_map``.
 
@@ -258,12 +385,12 @@ def route_cold_requests(
     """
     if dedup:
         ids = unique_first_occurrence(ids).uniques
+        routing = None   # the shared plan is over the un-deduped list
     b = ids.shape[0]
-    owner = jnp.where(ids >= 0, ids // nodes_per_shard, -1)
-    routing = _bucket_by_owner(ids, owner, num_shards, cap=b)
-    requests = lax.all_to_all(
-        routing.buckets.reshape(num_shards, b), axis_name, 0, 0,
-        tiled=False).reshape(num_shards * b)
+    if routing is None:
+        routing = build_routing(ids, nodes_per_shard, num_shards,
+                                route=route)
+    requests = _exchange_ids(routing, num_shards, b, axis_name)
     my_rank = lax.axis_index(axis_name)
     local = requests - my_rank * nodes_per_shard
     is_cold = (requests >= 0) & (local >= hot_per_shard) & (

@@ -27,7 +27,11 @@ from ..sampler.hetero_neighbor_sampler import (
     hetero_hop_widths,
 )
 from ..typing import EdgeType, NodeType, PADDING_ID
-from .dist_sampler import bounded_remote_cap, exchange_one_hop
+from .dist_sampler import (
+    autotune_routing,
+    bounded_remote_cap,
+    exchange_one_hop,
+)
 from .sharding import ShardedGraph, shard_graph
 
 
@@ -54,10 +58,13 @@ class DistHeteroNeighborSampler:
                  frontier_cap: Optional[int] = None,
                  seed: int = 0,
                  last_hop_dedup: bool = True,
-                 exchange_load_factor: Optional[float] = None):
+                 exchange_load_factor: Optional[float] = None,
+                 route: str = "auto",
+                 fused: Optional[bool] = None):
         self.sharded = sharded
         self.mesh = mesh
         self.axis_name = axis_name
+        self.fused = fused
         # Capacity-bounded exchange, per edge type (homo parity — VERDICT
         # r4 #4; the reference's hetero engine issues worst-case per-hop
         # RPC fan-outs, dist_neighbor_sampler.py:270-288): each hop's
@@ -98,6 +105,15 @@ class DistHeteroNeighborSampler:
             p.edge_types, p.num_neighbors, {input_type: self.batch_size},
             p.num_hops, frontier_cap=frontier_cap)
 
+        # Routing A/B seam (homo parity): autotune at the widest per-type
+        # frontier on TPU, heuristic elsewhere; GLT_ROUTE_FORCE still
+        # wins at trace time.
+        self.route = route
+        if route == "auto":
+            num_shards = next(iter(sharded.values())).num_shards
+            widest = max(max(w.values()) for w in self._widths)
+            self.route = autotune_routing(widest, num_shards)
+
         gspec = P(axis_name)
         arrays = {et: (g.indptr, g.indices, g.edge_ids)
                   for et, g in sharded.items()}
@@ -123,7 +139,7 @@ class DistHeteroNeighborSampler:
         nbrs, eids, mask, dropped = exchange_one_hop(
             frontier, indptr, indices, edge_ids, g.nodes_per_shard,
             g.num_shards, fanout, key, self.axis_name,
-            remote_cap=remote_cap)
+            remote_cap=remote_cap, route=self.route, fused=self.fused)
         if self.exchange_load_factor is not None:
             self._trace_dropped.append(dropped)
         return NeighborOutput(nbrs=nbrs, eids=eids, mask=mask)

@@ -20,6 +20,8 @@ worker-mode collocated layout, dist_loader.py:142-186).
 """
 from __future__ import annotations
 
+import os
+import time
 from functools import partial
 from typing import NamedTuple, Optional, Sequence
 
@@ -51,23 +53,71 @@ def bounded_remote_cap(width: int, load_factor: float,
                max(1, -(-int(round(load_factor * width)) // num_shards)))
 
 
-class _Routing(NamedTuple):
+class Routing(NamedTuple):
+    """Owner-bucketed routing plan for one frontier (see
+    :func:`build_routing`): everything an exchange needs to scatter ids
+    into per-owner request buckets and unscatter the responses.  Build it
+    ONCE per hop frontier and thread it through every exchange over that
+    frontier (neighbors, features, labels) — the plan depends only on
+    ``(ids, nodes_per_shard, num_shards, cap)``, not on the payload.
+    """
     buckets: jnp.ndarray   # [S * cap] ids grouped by owner, -1 padded
     slot: jnp.ndarray      # [B] bucket slot each input id landed in
     valid: jnp.ndarray     # [B] input validity (overflowed ids excluded)
     dropped: jnp.ndarray   # [] int32: ids beyond an owner's cap
 
 
-def _bucket_by_owner(ids: jnp.ndarray, owner: jnp.ndarray, num_shards: int,
-                     cap: int) -> _Routing:
-    """Group ids into per-owner rows of a static ``[S, cap]`` buffer.
+# Backward-compat alias (pre-routing-layer name).
+_Routing = Routing
 
-    The scatter order is stable (sort by owner), so every valid id gets slot
-    ``owner * cap + rank-within-owner``.  With ``cap = len(ids)`` overflow
-    is impossible (the reference-exact default); smaller capacity-bounded
-    buffers (see :func:`exchange_one_hop`'s ``remote_cap``) route ids past
-    an owner's cap to the trash slot, mark them invalid, and count them in
-    ``dropped`` so callers can observe the loss.
+# Decision table for route='auto': (b, num_shards, cap) -> 'onepass' |
+# 'sort', filled by autotune_routing at warmup.  Without an entry the
+# heuristic prefers the one-pass cumulative-mask path up to
+# _ONEPASS_MAX_SHARDS (its [B, S] rank matrix is O(B*S) elementwise work
+# vs the sort's O(B log B) — a clear win at small shard counts, a wash
+# and then a loss as S grows past the sort's log factor).
+_ROUTE_AUTO: dict = {}
+_ONEPASS_MAX_SHARDS = 16
+
+
+def _route_choice(b: int, num_shards: int, cap: int, route: str) -> str:
+    """Resolve the bucketing implementation at trace time.
+
+    Priority: ``GLT_ROUTE_FORCE`` env var > explicit ``route`` argument >
+    autotuned decision table > shard-count heuristic — the same seam
+    shape as ``gather_rows(force=)``/``GLT_GATHER_FORCE``.
+    """
+    env = os.environ.get("GLT_ROUTE_FORCE")
+    if env in ("sort", "onepass"):
+        return env
+    if route in ("sort", "onepass"):
+        return route
+    hit = _ROUTE_AUTO.get((int(b), int(num_shards), int(cap)))
+    if hit is not None:
+        return hit
+    return "onepass" if num_shards <= _ONEPASS_MAX_SHARDS else "sort"
+
+
+def _use_fused(fused: Optional[bool]) -> bool:
+    """Resolve the collective-fusion seam at trace time (default: fused).
+
+    ``GLT_COLLECTIVE_FORCE`` ('fused'|'split') overrides the argument —
+    the A/B escape hatch for the packed-payload collectives.
+    """
+    env = os.environ.get("GLT_COLLECTIVE_FORCE")
+    if env in ("fused", "split"):
+        return env == "fused"
+    return True if fused is None else bool(fused)
+
+
+def _bucket_by_owner_sort(ids: jnp.ndarray, owner: jnp.ndarray,
+                          num_shards: int, cap: int) -> Routing:
+    """Sort-based bucketing (the fallback path; see `_bucket_by_owner`).
+
+    Stable argsort by owner, then segment starts straight off the sorted
+    owner keys — O(S log B) searchsorted instead of a dense [B, S+1]
+    one-hot count, which at hop-2 frontier widths (50k+) dominated the
+    exchange prologue.
     """
     b = ids.shape[0]
     valid = ids >= 0
@@ -76,9 +126,6 @@ def _bucket_by_owner(ids: jnp.ndarray, owner: jnp.ndarray, num_shards: int,
     sorted_ids = ids[order]
     sorted_owner = owner_key[order]
 
-    # Segment starts straight off the sorted owner keys — O(S log B)
-    # searchsorted instead of a dense [B, S+1] one-hot count, which at
-    # hop-2 frontier widths (50k+) dominated the exchange prologue.
     starts = jnp.searchsorted(
         sorted_owner, jnp.arange(num_shards + 1, dtype=sorted_owner.dtype)
     ).astype(jnp.int32)
@@ -96,11 +143,128 @@ def _bucket_by_owner(ids: jnp.ndarray, owner: jnp.ndarray, num_shards: int,
         fits & (sorted_owner < num_shards))
     dropped = jnp.sum(((sorted_owner < num_shards) & ~fits)
                       .astype(jnp.int32))
-    return _Routing(buckets=buckets, slot=jnp.minimum(slot, num_shards * cap - 1),
-                    valid=valid & slot_valid, dropped=dropped)
+    return Routing(buckets=buckets, slot=jnp.minimum(slot, num_shards * cap - 1),
+                   valid=valid & slot_valid, dropped=dropped)
 
 
-def _bucket_payload(routing: _Routing, payload: jnp.ndarray,
+def _bucket_by_owner_onepass(ids: jnp.ndarray, owner: jnp.ndarray,
+                             num_shards: int, cap: int) -> Routing:
+    """Sort-free bucketing: one-pass per-owner rank via cumulative masks.
+
+    The stable sort's only job is the rank-within-owner; a [B, S] one-hot
+    cumsum computes the identical rank directly (input order within each
+    owner is preserved by construction), so every field is bit-identical
+    to :func:`_bucket_by_owner_sort` — O(B*S) elementwise work, no sort.
+    """
+    b = ids.shape[0]
+    valid = ids >= 0
+    owner_key = jnp.where(valid, owner, num_shards).astype(jnp.int32)
+    onehot = owner_key[:, None] == jnp.arange(num_shards,
+                                              dtype=jnp.int32)[None, :]
+    rank_m = jnp.cumsum(onehot.astype(jnp.int32), axis=0) - 1
+    rank = jnp.sum(jnp.where(onehot, rank_m, 0), axis=1)
+    in_range = owner_key < num_shards
+    fits = rank < cap
+    slot = jnp.where(in_range & fits,
+                     owner_key * cap + jnp.minimum(rank, cap - 1),
+                     num_shards * cap)
+    buckets = jnp.full((num_shards * cap + 1,), PADDING_ID, jnp.int32)
+    buckets = buckets.at[slot].set(ids)[:-1]
+    dropped = jnp.sum((in_range & ~fits).astype(jnp.int32))
+    return Routing(buckets=buckets,
+                   slot=jnp.minimum(slot, num_shards * cap - 1),
+                   valid=valid & in_range & fits, dropped=dropped)
+
+
+def _bucket_by_owner(ids: jnp.ndarray, owner: jnp.ndarray, num_shards: int,
+                     cap: int, route: str = "auto") -> Routing:
+    """Group ids into per-owner rows of a static ``[S, cap]`` buffer.
+
+    The scatter order is stable (input order within each owner), so every
+    valid id gets slot ``owner * cap + rank-within-owner``.  With ``cap =
+    len(ids)`` overflow is impossible (the reference-exact default);
+    smaller capacity-bounded buffers (see :func:`exchange_one_hop`'s
+    ``remote_cap``) route ids past an owner's cap to the trash slot, mark
+    them invalid, and count them in ``dropped`` so callers can observe
+    the loss.
+
+    ``route`` selects the rank computation ('onepass' cumulative masks vs
+    'sort' stable argsort — bit-identical outputs; see
+    :func:`_route_choice` for the 'auto' resolution order).
+    """
+    if _route_choice(ids.shape[0], num_shards, cap, route) == "onepass":
+        return _bucket_by_owner_onepass(ids, owner, num_shards, cap)
+    return _bucket_by_owner_sort(ids, owner, num_shards, cap)
+
+
+def build_routing(ids: jnp.ndarray, nodes_per_shard: int, num_shards: int,
+                  cap: Optional[int] = None,
+                  route: str = "auto") -> Routing:
+    """Build the owner-bucketed routing plan for a frontier of global ids.
+
+    Call inside ``shard_map``, ONCE per hop frontier, and thread the
+    result through every exchange over that frontier
+    (:func:`exchange_one_hop`,
+    :func:`~glt_tpu.parallel.dist_feature.exchange_gather`,
+    :func:`~glt_tpu.parallel.dist_feature.exchange_gather_hot`,
+    :func:`~glt_tpu.parallel.dist_feature.route_cold_requests`) — the
+    plan depends only on the ids and the contiguous partition geometry,
+    so rebuilding it per exchange (as the pre-routing-layer train step
+    did, 3x per batch) is pure waste.
+
+    Args:
+      ids: ``[B]`` global node ids, -1 padded.
+      cap: per-owner bucket capacity; ``None`` -> ``B`` (overflow-free).
+      route: 'auto' | 'onepass' | 'sort' (see :func:`_route_choice`).
+    """
+    owner = jnp.where(ids >= 0, ids // nodes_per_shard, -1)
+    return _bucket_by_owner(ids, owner, num_shards,
+                            ids.shape[0] if cap is None else int(cap),
+                            route=route)
+
+
+def autotune_routing(b: int, num_shards: int, cap: Optional[int] = None,
+                     iters: int = 3, seed: int = 0) -> str:
+    """Measure sort vs one-pass bucketing for this (B, S, cap) and
+    memoize the winner for ``route='auto'``.
+
+    Call EAGERLY at warmup (sampler construction) — never from inside a
+    trace.  Timing is fetch-synced (see bench.py: a host scalar fetch is
+    the only sync that provably waits under the axon tunnel).  Off-TPU
+    backends pin the shard-count heuristic without timing.
+    """
+    cap = b if cap is None else int(cap)
+    key = (int(b), int(num_shards), cap)
+    if key in _ROUTE_AUTO:
+        return _ROUTE_AUTO[key]
+    choice = "onepass" if num_shards <= _ONEPASS_MAX_SHARDS else "sort"
+    if jax.default_backend() == "tpu":
+        try:
+            rng = np.random.default_rng(seed)
+            ids = jnp.asarray(rng.integers(
+                0, num_shards * max(b, 1), size=b).astype(np.int32))
+            owner = jnp.asarray(rng.integers(
+                0, num_shards, size=b).astype(np.int32))
+
+            def timed(fn):
+                f = jax.jit(partial(fn, num_shards=num_shards, cap=cap))
+                int(f(ids, owner).dropped)   # compile + warm
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = f(ids, owner)
+                int(out.dropped)             # fetch = true sync
+                return time.perf_counter() - t0
+
+            t_sort = timed(_bucket_by_owner_sort)
+            t_one = timed(_bucket_by_owner_onepass)
+            choice = "onepass" if t_one < t_sort else "sort"
+        except Exception:  # pragma: no cover - backend quirk: keep fallback
+            choice = "sort"
+    _ROUTE_AUTO[key] = choice
+    return choice
+
+
+def _bucket_payload(routing: Routing, payload: jnp.ndarray,
                     num_shards: int, cap: int) -> jnp.ndarray:
     """Scatter a payload array into the same bucket slots as its ids."""
     buckets = jnp.full((num_shards * cap + 1,), PADDING_ID, jnp.int32)
@@ -159,26 +323,38 @@ def dist_edge_exists(
     nodes_per_shard: int,
     num_shards: int,
     axis_name: str,
+    route: str = "auto",
+    fused: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Global membership test for (src, dst) pairs; call inside shard_map.
 
-    Routes each candidate pair to the shard owning ``src`` (one id
-    all-to-all + one payload all-to-all), runs the local sorted-view
-    lookup there, and routes the verdicts back — the collective rebuild
-    of the reference's strict negative check, which it *skips* in
-    distributed mode (dist_neighbor_sampler.py:327-453 uses non-strict
-    draws).  Returns ``[B]`` bool (False for padding slots).
+    Routes each candidate pair to the shard owning ``src`` (one fused
+    id+payload all-to-all), runs the local sorted-view lookup there, and
+    routes the verdicts back — the collective rebuild of the reference's
+    strict negative check, which it *skips* in distributed mode
+    (dist_neighbor_sampler.py:327-453 uses non-strict draws).  Returns
+    ``[B]`` bool (False for padding slots).
     """
     b = src.shape[0]
     my_rank = lax.axis_index(axis_name)
     owner = jnp.where(src >= 0, src // nodes_per_shard, -1)
-    routing = _bucket_by_owner(src, owner, num_shards, cap=b)
+    routing = _bucket_by_owner(src, owner, num_shards, cap=b, route=route)
     dst_buckets = _bucket_payload(routing, dst, num_shards, b)
 
-    req_s = lax.all_to_all(routing.buckets.reshape(num_shards, b),
-                           axis_name, 0, 0, tiled=False).reshape(-1)
-    req_d = lax.all_to_all(dst_buckets.reshape(num_shards, b),
-                           axis_name, 0, 0, tiled=False).reshape(-1)
+    if _use_fused(fused):
+        # src ids and dst payload ride ONE collective as a packed [.., 2]
+        # block — all_to_all moves axis-0 blocks, so the trailing pack
+        # axis is inert and the unpacked halves are bit-identical to the
+        # split path's two launches.
+        pair = jnp.stack([routing.buckets, dst_buckets], axis=-1)
+        req = lax.all_to_all(pair.reshape(num_shards, b, 2), axis_name,
+                             0, 0, tiled=False).reshape(num_shards * b, 2)
+        req_s, req_d = req[:, 0], req[:, 1]
+    else:
+        req_s = lax.all_to_all(routing.buckets.reshape(num_shards, b),
+                               axis_name, 0, 0, tiled=False).reshape(-1)
+        req_d = lax.all_to_all(dst_buckets.reshape(num_shards, b),
+                               axis_name, 0, 0, tiled=False).reshape(-1)
 
     local = req_s - my_rank * nodes_per_shard
     ok = (req_s >= 0) & (local >= 0) & (local < nodes_per_shard)
@@ -203,6 +379,9 @@ def exchange_one_hop(
     key: jax.Array,
     axis_name: str,
     remote_cap: Optional[int] = None,
+    route: str = "auto",
+    fused: Optional[bool] = None,
+    routing: Optional[Routing] = None,
 ):
     """One distributed sampling hop; call inside ``shard_map``.
 
@@ -224,6 +403,12 @@ def exchange_one_hop(
         width ``remote_cap``, shrinking exchange bytes by ``S*B /
         (S*remote_cap)``.  Ids past an owner's cap are dropped (masked
         padding, never garbage) and counted.
+      route / fused: routing-path and collective-fusion seams (see
+        :func:`_route_choice` / :func:`_use_fused`).
+      routing: pre-built full-width :class:`Routing` for ``seeds`` (from
+        :func:`build_routing`) — only honored when ``remote_cap`` is
+        None (the capped path buckets the remote-masked subset, a
+        different plan).
 
     Returns:
       ``(nbrs, eids, mask, dropped)``; first three ``[B, fanout]`` in seed
@@ -235,7 +420,9 @@ def exchange_one_hop(
     owner = jnp.where(seeds >= 0, seeds // nodes_per_shard, -1)
 
     if remote_cap is None:
-        routing = _bucket_by_owner(seeds, owner, num_shards, cap=b)
+        if routing is None:
+            routing = _bucket_by_owner(seeds, owner, num_shards, cap=b,
+                                       route=route)
         cap = b
         local_nbrs = local_eids = None
     else:
@@ -248,7 +435,8 @@ def exchange_one_hop(
                                 edge_ids=edge_ids)
         local_nbrs, local_eids = lout.nbrs, lout.eids
         remote_ids = jnp.where(is_local, PADDING_ID, seeds)
-        routing = _bucket_by_owner(remote_ids, owner, num_shards, cap=cap)
+        routing = _bucket_by_owner(remote_ids, owner, num_shards, cap=cap,
+                                   route=route)
 
     # Request exchange: row q of `requests` = ids wanted by shard q from us.
     requests = lax.all_to_all(
@@ -263,12 +451,21 @@ def exchange_one_hop(
                            jax.random.fold_in(key, 1), edge_ids=edge_ids)
 
     # Response exchange + unscatter (the stitch, stitch_sample_results.cu:57).
-    resp_nbrs = lax.all_to_all(
-        out.nbrs.reshape(num_shards, cap, fanout), axis_name, 0, 0,
-        tiled=False).reshape(num_shards * cap, fanout)
-    resp_eids = lax.all_to_all(
-        out.eids.reshape(num_shards, cap, fanout), axis_name, 0, 0,
-        tiled=False).reshape(num_shards * cap, fanout)
+    if _use_fused(fused):
+        # Neighbors and edge ids ride ONE [S, cap, 2*fanout] collective
+        # (half the per-hop launches); the halves split back bit-exact.
+        resp = lax.all_to_all(
+            jnp.concatenate([out.nbrs, out.eids], axis=-1)
+            .reshape(num_shards, cap, 2 * fanout), axis_name, 0, 0,
+            tiled=False).reshape(num_shards * cap, 2 * fanout)
+        resp_nbrs, resp_eids = resp[:, :fanout], resp[:, fanout:]
+    else:
+        resp_nbrs = lax.all_to_all(
+            out.nbrs.reshape(num_shards, cap, fanout), axis_name, 0, 0,
+            tiled=False).reshape(num_shards * cap, fanout)
+        resp_eids = lax.all_to_all(
+            out.eids.reshape(num_shards, cap, fanout), axis_name, 0, 0,
+            tiled=False).reshape(num_shards * cap, fanout)
 
     nbrs = jnp.where(routing.valid[:, None],
                      resp_nbrs[routing.slot], PADDING_ID)
@@ -292,6 +489,9 @@ def exchange_one_hop_ring(
     key: jax.Array,
     axis_name: str,
     remote_cap: Optional[int] = None,
+    route: str = "auto",
+    fused: Optional[bool] = None,
+    routing: Optional[Routing] = None,
 ):
     """Ring-pipelined variant of :func:`exchange_one_hop`.
 
@@ -303,6 +503,8 @@ def exchange_one_hop_ring(
     when overlapping sampling compute with transfers matters more than
     burst bandwidth.  ``remote_cap`` bounds the travelling matrix exactly
     as in :func:`exchange_one_hop` (local seeds never enter the ring).
+    With ``fused`` the neighbor/edge-id answer buffers travel as one
+    packed block, cutting the per-step ppermute launches from 3 to 2.
     """
     b = seeds.shape[0]
     my = lax.axis_index(axis_name)
@@ -317,7 +519,9 @@ def exchange_one_hop_ring(
 
     if remote_cap is None:
         cap = b
-        routing = _bucket_by_owner(seeds, owner, num_shards, cap=cap)
+        if routing is None:
+            routing = _bucket_by_owner(seeds, owner, num_shards, cap=cap,
+                                       route=route)
         local_nbrs = local_eids = is_local = None
     else:
         cap = int(remote_cap)
@@ -327,9 +531,10 @@ def exchange_one_hop_ring(
         local_nbrs, local_eids = lout.nbrs, lout.eids
         routing = _bucket_by_owner(
             jnp.where(is_local, PADDING_ID, seeds), owner, num_shards,
-            cap=cap)
+            cap=cap, route=route)
 
     right = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+    fuse = _use_fused(fused)
 
     # The request matrix and its answer buffers travel the ring together:
     # after k rotations shard i holds the matrix that originated at shard
@@ -337,26 +542,45 @@ def exchange_one_hop_ring(
     # After a final rotation (num_shards total) every matrix is home with
     # all rows answered — one serve + one hop per step, fully pipelined.
     reqs = routing.buckets.reshape(num_shards, cap)
-    ans_n = jnp.full((num_shards, cap, fanout), PADDING_ID, jnp.int32)
-    ans_e = jnp.full((num_shards, cap, fanout), PADDING_ID, jnp.int32)
+    if fuse:
+        ans = jnp.full((num_shards, cap, 2 * fanout), PADDING_ID,
+                       jnp.int32)
 
-    def serve(reqs, ans_n, ans_e, k):
-        incoming = jnp.take(reqs, my, axis=0)
-        o = local_sample(incoming, k)
-        return ans_n.at[my].set(o.nbrs), ans_e.at[my].set(o.eids)
+        def serve(reqs, ans, k):
+            o = local_sample(jnp.take(reqs, my, axis=0), k)
+            return ans.at[my].set(
+                jnp.concatenate([o.nbrs, o.eids], axis=-1))
 
-    ans_n, ans_e = serve(reqs, ans_n, ans_e, 0)
-    for k in range(1, num_shards):
-        reqs = lax.ppermute(reqs, axis_name, right)
-        ans_n = lax.ppermute(ans_n, axis_name, right)
-        ans_e = lax.ppermute(ans_e, axis_name, right)
-        ans_n, ans_e = serve(reqs, ans_n, ans_e, k)
-    if num_shards > 1:
-        ans_n = lax.ppermute(ans_n, axis_name, right)
-        ans_e = lax.ppermute(ans_e, axis_name, right)
+        ans = serve(reqs, ans, 0)
+        for k in range(1, num_shards):
+            reqs = lax.ppermute(reqs, axis_name, right)
+            ans = lax.ppermute(ans, axis_name, right)
+            ans = serve(reqs, ans, k)
+        if num_shards > 1:
+            ans = lax.ppermute(ans, axis_name, right)
+        ans = ans.reshape(num_shards * cap, 2 * fanout)
+        resp_nbrs, resp_eids = ans[:, :fanout], ans[:, fanout:]
+    else:
+        ans_n = jnp.full((num_shards, cap, fanout), PADDING_ID, jnp.int32)
+        ans_e = jnp.full((num_shards, cap, fanout), PADDING_ID, jnp.int32)
 
-    resp_nbrs = ans_n.reshape(num_shards * cap, fanout)
-    resp_eids = ans_e.reshape(num_shards * cap, fanout)
+        def serve(reqs, ans_n, ans_e, k):
+            incoming = jnp.take(reqs, my, axis=0)
+            o = local_sample(incoming, k)
+            return ans_n.at[my].set(o.nbrs), ans_e.at[my].set(o.eids)
+
+        ans_n, ans_e = serve(reqs, ans_n, ans_e, 0)
+        for k in range(1, num_shards):
+            reqs = lax.ppermute(reqs, axis_name, right)
+            ans_n = lax.ppermute(ans_n, axis_name, right)
+            ans_e = lax.ppermute(ans_e, axis_name, right)
+            ans_n, ans_e = serve(reqs, ans_n, ans_e, k)
+        if num_shards > 1:
+            ans_n = lax.ppermute(ans_n, axis_name, right)
+            ans_e = lax.ppermute(ans_e, axis_name, right)
+
+        resp_nbrs = ans_n.reshape(num_shards * cap, fanout)
+        resp_eids = ans_e.reshape(num_shards * cap, fanout)
     nbrs = jnp.where(routing.valid[:, None], resp_nbrs[routing.slot],
                      PADDING_ID)
     eids = jnp.where(routing.valid[:, None], resp_eids[routing.slot],
@@ -383,6 +607,8 @@ def dist_sample_multi_hop(
     dedup: str = "auto",
     last_hop_dedup: bool = True,
     exchange_load_factor: Optional[float] = None,
+    route: str = "auto",
+    fused: Optional[bool] = None,
 ) -> SamplerOutput:
     """Per-shard multi-hop sampling body; call inside ``shard_map``.
 
@@ -404,6 +630,12 @@ def dist_sample_multi_hop(
     are surfaced in ``metadata['exchange_dropped']`` — with contiguous
     partitions and shard-local seeds α≈2 makes drops rare; monitor the
     counter and raise α (or use None = exact) if it is ever nonzero.
+
+    ``route`` / ``fused`` select the bucketing implementation and the
+    packed response collective (see :func:`_route_choice` /
+    :func:`_use_fused`); on the exact (uncapped) path each hop's routing
+    plan is built ONCE via :func:`build_routing` and threaded into the
+    exchange.
     """
     exchange = (exchange_one_hop if collective == "all_to_all"
                 else exchange_one_hop_ring)
@@ -444,9 +676,16 @@ def dist_sample_multi_hop(
         remote_cap = (None if exchange_load_factor is None
                       else bounded_remote_cap(w, exchange_load_factor,
                                               num_shards))
+        # One routing plan per hop frontier (exact path); the capped
+        # path buckets only the remote-masked subset inside the
+        # exchange, a different plan per construction.
+        hop_routing = (build_routing(frontier, nodes_per_shard,
+                                     num_shards, route=route)
+                       if remote_cap is None else None)
         nbrs, eids, mask, dropped = exchange(
             frontier, indptr, indices, edge_ids, nodes_per_shard,
-            num_shards, f, keys[i], axis_name, remote_cap=remote_cap)
+            num_shards, f, keys[i], axis_name, remote_cap=remote_cap,
+            route=route, fused=fused, routing=hop_routing)
         dropped_total = dropped_total + dropped
 
         src_local = frontier_start + jnp.arange(w, dtype=jnp.int32)
@@ -539,6 +778,8 @@ def dist_node_subgraph(
     nodes_per_shard: int,
     num_shards: int,
     axis_name: str,
+    route: str = "auto",
+    fused: Optional[bool] = None,
 ):
     """Distributed induced-subgraph extraction; call inside ``shard_map``.
 
@@ -557,8 +798,8 @@ def dist_node_subgraph(
     :class:`~glt_tpu.ops.subgraph.SubGraphOutput`.
     """
     b = nodes.shape[0]
-    owner = jnp.where(nodes >= 0, nodes // nodes_per_shard, -1)
-    routing = _bucket_by_owner(nodes, owner, num_shards, cap=b)
+    routing = build_routing(nodes, nodes_per_shard, num_shards,
+                            route=route)
 
     requests = lax.all_to_all(
         routing.buckets.reshape(num_shards, b), axis_name, 0, 0,
@@ -576,12 +817,19 @@ def dist_node_subgraph(
     nbrs = jnp.where(in_row, indices[flat], PADDING_ID).astype(jnp.int32)
     eids = jnp.where(in_row, edge_ids[flat], PADDING_ID).astype(jnp.int32)
 
-    resp_nbrs = lax.all_to_all(
-        nbrs.reshape(num_shards, b, max_degree), axis_name, 0, 0,
-        tiled=False).reshape(num_shards * b, max_degree)
-    resp_eids = lax.all_to_all(
-        eids.reshape(num_shards, b, max_degree), axis_name, 0, 0,
-        tiled=False).reshape(num_shards * b, max_degree)
+    if _use_fused(fused):
+        resp = lax.all_to_all(
+            jnp.concatenate([nbrs, eids], axis=-1)
+            .reshape(num_shards, b, 2 * max_degree), axis_name, 0, 0,
+            tiled=False).reshape(num_shards * b, 2 * max_degree)
+        resp_nbrs, resp_eids = resp[:, :max_degree], resp[:, max_degree:]
+    else:
+        resp_nbrs = lax.all_to_all(
+            nbrs.reshape(num_shards, b, max_degree), axis_name, 0, 0,
+            tiled=False).reshape(num_shards * b, max_degree)
+        resp_eids = lax.all_to_all(
+            eids.reshape(num_shards, b, max_degree), axis_name, 0, 0,
+            tiled=False).reshape(num_shards * b, max_degree)
     nbrs = jnp.where(routing.valid[:, None], resp_nbrs[routing.slot],
                      PADDING_ID)
     eids = jnp.where(routing.valid[:, None], resp_eids[routing.slot],
@@ -618,11 +866,14 @@ class DistNeighborSampler:
                  valid_per_shard: Optional[np.ndarray] = None,
                  seed: int = 0,
                  last_hop_dedup: bool = True,
-                 exchange_load_factor: Optional[float] = None):
+                 exchange_load_factor: Optional[float] = None,
+                 route: str = "auto",
+                 fused: Optional[bool] = None):
         self.collective = collective
         self.valid_per_shard = valid_per_shard
         self.last_hop_dedup = bool(last_hop_dedup)
         self.exchange_load_factor = exchange_load_factor
+        self.fused = fused
         self._edges_fns = {}
         self._subgraph_fns = {}
         self.g = sharded_graph
@@ -635,6 +886,14 @@ class DistNeighborSampler:
         self._call_count = 0
         self._widths = hop_widths(self.batch_size, self.num_neighbors,
                                   frontier_cap)
+        # Routing A/B seam: 'auto' autotunes sort vs one-pass at the
+        # dominant (widest-frontier) shape on TPU; elsewhere the
+        # shard-count heuristic picks (env GLT_ROUTE_FORCE still wins at
+        # trace time — see _route_choice).
+        self.route = route
+        if route == "auto":
+            self.route = autotune_routing(max(self._widths),
+                                          self.g.num_shards)
         self.node_capacity = max_sampled_nodes(self.batch_size,
                                                self.num_neighbors,
                                                frontier_cap)
@@ -664,7 +923,8 @@ class DistNeighborSampler:
             self.num_neighbors, self.g.nodes_per_shard, self.g.num_shards,
             self.axis_name, self.frontier_cap, self.collective,
             last_hop_dedup=self.last_hop_dedup,
-            exchange_load_factor=self.exchange_load_factor)
+            exchange_load_factor=self.exchange_load_factor,
+            route=self.route, fused=self.fused)
         # Re-add the shard axis for shard_map's out_specs.
         return jax.tree.map(lambda x: x[None], out)
 
@@ -796,7 +1056,8 @@ class DistNeighborSampler:
                 d = uniform_ids(kd_, n)
                 ex = dist_edge_exists(
                     rows_s, dsts_s, jnp.where(valid, s, PADDING_ID), d,
-                    c, s_count, self.axis_name)
+                    c, s_count, self.axis_name, route=self.route,
+                    fused=self.fused)
                 take = valid & ~found & ~ex
                 best_s = jnp.where(take, s, best_s)
                 best_d = jnp.where(take, d, best_d)
@@ -837,7 +1098,8 @@ class DistNeighborSampler:
             indptr, indices, eids, seeds, ksample, self.num_neighbors,
             c, s_count, self.axis_name, self.frontier_cap, self.collective,
             last_hop_dedup=self.last_hop_dedup,
-            exchange_load_factor=self.exchange_load_factor)
+            exchange_load_factor=self.exchange_load_factor,
+            route=self.route, fused=self.fused)
 
         # Seed ids first-occur in the hop-0 prefix; relabel against that
         # slice only (the no-dedup leaf block may repeat seed ids).
@@ -891,11 +1153,12 @@ class DistNeighborSampler:
                     indptr[0], indices[0], eids[0], seeds[0], key,
                     self.num_neighbors, self.g.nodes_per_shard,
                     self.g.num_shards, self.axis_name, self.frontier_cap,
-                    self.collective, last_hop_dedup=True)
+                    self.collective, last_hop_dedup=True,
+                    route=self.route, fused=self.fused)
                 rows, cols, se, mask = dist_node_subgraph(
                     indptr[0], indices[0], eids[0], base.node, max_degree,
                     self.g.nodes_per_shard, self.g.num_shards,
-                    self.axis_name)
+                    self.axis_name, route=self.route, fused=self.fused)
                 out = SamplerOutput(
                     node=base.node, row=rows, col=cols, edge=se,
                     batch=seeds[0], node_mask=base.node_mask,

@@ -32,6 +32,7 @@ from .dist_feature import (
     _dedup_scatter_back,
     exchange_gather,
     exchange_gather_hot,
+    exchange_gather_xy,
     route_cold_requests,
 )
 from .dist_sampler import DistNeighborSampler, dist_sample_multi_hop
@@ -52,6 +53,8 @@ def make_dist_train_step(
     last_hop_dedup: bool = True,
     exchange_load_factor: Optional[float] = None,
     dedup_gather: bool = False,
+    route: str = "auto",
+    fused: Optional[bool] = None,
 ):
     """Build ``step(state, seeds [S, B], key) -> (state, loss, acc)``.
 
@@ -67,8 +70,16 @@ def make_dist_train_step(
     exchange (one unique pass shared by both) and scatters rows back —
     bit-identical batches, duplicated ids cross the ICI once; pair it
     with ``last_hop_dedup=False``, whose leaf blocks repeat hub nodes.
+    ``route`` / ``fused`` select the routing implementation and fused
+    collectives (see :mod:`~glt_tpu.parallel.dist_sampler`): features +
+    labels ride ONE routing plan and ONE payload collective
+    (:func:`~glt_tpu.parallel.dist_feature.exchange_gather_xy`).
     """
     gspec = P(axis_name)
+    # Feature/label fusion needs one id space for both (always true for
+    # shard_graph/shard_feature over the same node set).
+    fuse_xy = (f.nodes_per_shard == g.nodes_per_shard
+               and f.num_shards == g.num_shards)
 
     def local_body(indptr, indices, edge_ids, rows, labels_blk, seeds,
                    params, key):
@@ -80,25 +91,35 @@ def make_dist_train_step(
             indptr, indices, edge_ids, seeds, key, num_neighbors,
             g.nodes_per_shard, g.num_shards, axis_name, frontier_cap,
             last_hop_dedup=last_hop_dedup,
-            exchange_load_factor=exchange_load_factor)
-        if dedup_gather:
+            exchange_load_factor=exchange_load_factor,
+            route=route, fused=fused)
+        if fuse_xy:
+            # ONE routing plan + ONE payload collective for features AND
+            # labels (dedup additionally shares a single unique pass).
+            x, y = exchange_gather_xy(
+                out.node, rows, labels_blk, f.nodes_per_shard,
+                f.num_shards, axis_name, dedup=dedup_gather, route=route,
+                fused=fused)
+        elif dedup_gather:
             # ONE unique pass feeds both exchanges; rows/labels scatter
             # back to every original position (bit-identical batch).
             uniq, inv, _ = unique_first_occurrence(out.node)
             x = _dedup_scatter_back(
                 exchange_gather(uniq, rows, f.nodes_per_shard,
-                                f.num_shards, axis_name), inv)
+                                f.num_shards, axis_name, route=route),
+                inv)
             y = _dedup_scatter_back(
                 exchange_gather(uniq, labels_blk[:, None].astype(jnp.int32),
-                                g.nodes_per_shard, g.num_shards, axis_name),
+                                g.nodes_per_shard, g.num_shards, axis_name,
+                                route=route),
                 inv)[:, 0]
         else:
             x = exchange_gather(out.node, rows, f.nodes_per_shard,
-                                f.num_shards, axis_name)
+                                f.num_shards, axis_name, route=route)
             y = exchange_gather(out.node,
                                 labels_blk[:, None].astype(jnp.int32),
                                 g.nodes_per_shard, g.num_shards,
-                                axis_name)[:, 0]
+                                axis_name, route=route)[:, 0]
         y = jnp.where(out.node >= 0, y, PADDING_ID)
         edge_index = jnp.stack([out.row, out.col])
 
@@ -150,6 +171,8 @@ def make_tiered_train_step(
     batch_size: int,
     axis_name: str = "shard",
     dedup_gather: bool = False,
+    route: str = "auto",
+    fused: Optional[bool] = None,
 ):
     """Build the train half of the tiered two-stage pipeline.
 
@@ -167,9 +190,14 @@ def make_tiered_train_step(
 
     ``dedup_gather`` must match the :class:`TieredTrainPipeline`'s flag:
     the staged cold rows are keyed to the (possibly deduped) request
-    layout.
+    layout.  The hot feature gather and the label gather share one
+    routing plan and one fused payload collective
+    (:func:`~glt_tpu.parallel.dist_feature.exchange_gather_xy`) when the
+    graph and feature id spaces agree.
     """
     gspec = P(axis_name)
+    fuse_xy = (f.nodes_per_shard == g.nodes_per_shard
+               and f.num_shards == g.num_shards)
 
     def local_body(hot_rows, labels_blk, out, staged_rows, staged_slots,
                    params, key):
@@ -178,14 +206,22 @@ def make_tiered_train_step(
         out = jax.tree.map(lambda x: x[0], out)
         key = jax.random.fold_in(key, lax.axis_index(axis_name))
 
-        x = exchange_gather_hot(out.node, hot_rows, f.nodes_per_shard,
-                                f.hot_per_shard, f.num_shards, axis_name,
-                                staged_rows=staged_rows,
-                                staged_slots=staged_slots,
-                                dedup=dedup_gather)
-        y = exchange_gather(out.node, labels_blk[:, None].astype(jnp.int32),
-                            g.nodes_per_shard, g.num_shards, axis_name,
-                            dedup=dedup_gather)[:, 0]
+        if fuse_xy:
+            x, y = exchange_gather_xy(
+                out.node, hot_rows, labels_blk, f.nodes_per_shard,
+                f.num_shards, axis_name, hot_per_shard=f.hot_per_shard,
+                staged_rows=staged_rows, staged_slots=staged_slots,
+                dedup=dedup_gather, route=route, fused=fused)
+        else:
+            x = exchange_gather_hot(out.node, hot_rows, f.nodes_per_shard,
+                                    f.hot_per_shard, f.num_shards,
+                                    axis_name, staged_rows=staged_rows,
+                                    staged_slots=staged_slots,
+                                    dedup=dedup_gather, route=route)
+            y = exchange_gather(out.node,
+                                labels_blk[:, None].astype(jnp.int32),
+                                g.nodes_per_shard, g.num_shards, axis_name,
+                                dedup=dedup_gather, route=route)[:, 0]
         y = jnp.where(out.node >= 0, y, PADDING_ID)
         edge_index = jnp.stack([out.row, out.col])
 
@@ -390,7 +426,8 @@ class TieredTrainPipeline(_ColdStagePipeline):
                  cold_store: Optional[HostColdStore] = None,
                  cold_cap: Optional[int] = None,
                  stage_threads: Optional[int] = None,
-                 dedup_gather: bool = False):
+                 dedup_gather: bool = False,
+                 route: str = "auto"):
         from . import multihost
         from .dist_feature import compact_cold_requests
 
@@ -430,7 +467,7 @@ class TieredTrainPipeline(_ColdStagePipeline):
             # slots index the (possibly deduped) request layout.
             req = route_cold_requests(
                 nodes[0], f.nodes_per_shard, f.hot_per_shard,
-                f.num_shards, axis_name, dedup=dedup_gather)
+                f.num_shards, axis_name, dedup=dedup_gather, route=route)
             slots, ids, dropped = compact_cold_requests(req, self.cold_cap)
             return slots[None], ids[None], dropped[None]
 
@@ -522,6 +559,8 @@ def make_hetero_dist_train_step(
     mesh: Mesh,
     batch_size: int,
     axis_name: str = "shard",
+    route: str = "auto",
+    fused: Optional[bool] = None,
 ):
     """Hetero analog of :func:`make_dist_train_step` (cf. the reference's
     igbh distributed run, examples/igbh/dist_train_rgat.py): hetero
@@ -530,7 +569,9 @@ def make_hetero_dist_train_step(
 
     ``model.edge_types`` must use the sampler's *reversed* output keys
     (``reverse_edge_type`` of the dataset's edge types), and
-    ``model.target_type`` == ``sampler.input_type``.
+    ``model.target_type`` == ``sampler.input_type``.  The target type's
+    feature gather and the label gather share one routing plan + one
+    fused payload collective (``exchange_gather_xy``).
     """
     gspec = P(axis_name)
     tgt = sampler.input_type
@@ -540,6 +581,7 @@ def make_hetero_dist_train_step(
     meta = {t: (f.nodes_per_shard, f.num_shards) for t, f in feats.items()}
     label_c = int(labels.shape[1])
     num_shards = next(iter(sampler.sharded.values())).num_shards
+    fuse_xy = (meta[tgt][0] == label_c and meta[tgt][1] == num_shards)
 
     def local_body(arrays_blk, rows_blk, labels_blk, seeds_blk, params,
                    key):
@@ -550,12 +592,20 @@ def make_hetero_dist_train_step(
         kdrop, ksample = jax.random.split(key)
 
         out = sampler.local_sample(arrays_l, seeds, ksample)
-        x = {t: exchange_gather(out.node[t], rows_l[t], meta[t][0],
-                                meta[t][1], axis_name)
-             for t in rows_l}
-        y = exchange_gather(out.node[tgt],
-                            labels_l[:, None].astype(jnp.int32),
-                            label_c, num_shards, axis_name)[:, 0]
+        x, y = {}, None
+        for t in rows_l:
+            if t == tgt and fuse_xy:
+                x[t], y = exchange_gather_xy(
+                    out.node[t], rows_l[t], labels_l, meta[t][0],
+                    meta[t][1], axis_name, route=route, fused=fused)
+            else:
+                x[t] = exchange_gather(out.node[t], rows_l[t], meta[t][0],
+                                       meta[t][1], axis_name, route=route)
+        if y is None:
+            y = exchange_gather(out.node[tgt],
+                                labels_l[:, None].astype(jnp.int32),
+                                label_c, num_shards, axis_name,
+                                route=route)[:, 0]
         y = jnp.where(out.node[tgt] >= 0, y, PADDING_ID)
         edge_index = {et: jnp.stack([out.row[et], out.col[et]])
                       for et in out.row}
@@ -606,6 +656,8 @@ def make_hetero_tiered_train_step(
     mesh: Mesh,
     batch_size: int,
     axis_name: str = "shard",
+    route: str = "auto",
+    fused: Optional[bool] = None,
 ):
     """Hetero analog of :func:`make_tiered_train_step` (VERDICT r4 #4):
     node types whose feature is a :class:`TieredShardedFeature` (e.g.
@@ -631,6 +683,7 @@ def make_hetero_tiered_train_step(
                 f.num_shards) for t, f in feats.items()}
     label_c = int(labels.shape[1])
     num_shards = next(iter(sampler.sharded.values())).num_shards
+    fuse_xy = (meta[tgt][0] == label_c and meta[tgt][2] == num_shards)
 
     def local_body(hot_blk, labels_blk, out, srows_blk, sslots_blk, params,
                    key):
@@ -641,20 +694,31 @@ def make_hetero_tiered_train_step(
         out = jax.tree.map(lambda x: x[0], out)
         key = jax.random.fold_in(key, lax.axis_index(axis_name))
 
-        x = {}
+        x, y = {}, None
         for t in hot_l:
             c, h, s = meta[t]
-            if t in srows:
+            if t == tgt and fuse_xy:
+                # Target-type features (hot tier + staged cold when
+                # tiered) and labels ride one routing plan + one fused
+                # payload collective.
+                x[t], y = exchange_gather_xy(
+                    out.node[t], hot_l[t], labels_l, c, s, axis_name,
+                    hot_per_shard=h, staged_rows=srows.get(t),
+                    staged_slots=sslots.get(t), route=route, fused=fused)
+            elif t in srows:
                 x[t] = exchange_gather_hot(out.node[t], hot_l[t], c, h, s,
                                            axis_name,
                                            staged_rows=srows[t],
-                                           staged_slots=sslots[t])
+                                           staged_slots=sslots[t],
+                                           route=route)
             else:
                 x[t] = exchange_gather(out.node[t], hot_l[t], c, s,
-                                       axis_name)
-        y = exchange_gather(out.node[tgt],
-                            labels_l[:, None].astype(jnp.int32),
-                            label_c, num_shards, axis_name)[:, 0]
+                                       axis_name, route=route)
+        if y is None:
+            y = exchange_gather(out.node[tgt],
+                                labels_l[:, None].astype(jnp.int32),
+                                label_c, num_shards, axis_name,
+                                route=route)[:, 0]
         y = jnp.where(out.node[tgt] >= 0, y, PADDING_ID)
         edge_index = {et: jnp.stack([out.row[et], out.col[et]])
                       for et in out.row}
@@ -711,7 +775,8 @@ class HeteroTieredTrainPipeline(_ColdStagePipeline):
     def __init__(self, sampler, train_step, feats, mesh: Mesh,
                  axis_name: str = "shard",
                  cold_caps=None,
-                 stage_threads: Optional[int] = None):
+                 stage_threads: Optional[int] = None,
+                 route: str = "auto"):
         from . import multihost
         from .dist_feature import compact_cold_requests
 
@@ -748,7 +813,7 @@ class HeteroTieredTrainPipeline(_ColdStagePipeline):
                 f = self.tiered[t]
                 req = route_cold_requests(
                     nodes_blk[t][0], f.nodes_per_shard, f.hot_per_shard,
-                    f.num_shards, axis_name)
+                    f.num_shards, axis_name, route=route)
                 s, i, d = compact_cold_requests(req, self.cold_cap[t])
                 slots[t], ids[t], dropped[t] = s[None], i[None], d[None]
             return slots, ids, dropped

@@ -1,0 +1,111 @@
+"""Dist-path microbench smoke (slow-marked; CI job ``microbench-smoke``).
+
+Guards the distributed hot path the bench measures on the real chip: the
+routing A/B seam, the fused collectives, the routing-only breakdown
+program bench.py times for ``dist_routing_ms``, and the fused dist train
+step — all on the virtual 8-device CPU mesh, at toy scale.  A broken
+seam fails here even when nothing else exercises the forced paths.
+"""
+import os
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N_DEV = 8
+
+
+def _power_law_ring(n, rng):
+    """Tiny power-law-ish graph: ring backbone + hub edges."""
+    src = list(np.repeat(np.arange(n), 2))
+    dst = list(np.concatenate([[(i + 1) % n, (i + 2) % n]
+                               for i in range(n)]))
+    hubs = rng.integers(0, n // 8, n)        # skewed toward low ids
+    src += list(np.arange(n))
+    dst += list(hubs)
+    from glt_tpu.data.topology import CSRTopo
+
+    return CSRTopo(np.stack([np.array(src), np.array(dst)]), num_nodes=n)
+
+
+@pytest.mark.slow
+def test_dist_path_smoke():
+    import optax
+
+    from glt_tpu.models import GraphSAGE
+    from glt_tpu.parallel import (
+        DistNeighborSampler,
+        init_dist_state,
+        make_dist_train_step,
+        shard_feature,
+        shard_graph,
+    )
+
+    rng = np.random.default_rng(0)
+    n, d, classes = 128, 8, 4
+    topo = _power_law_ring(n, rng)
+    mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("shard",))
+    sg = shard_graph(topo, N_DEV)
+    feat = rng.normal(0, 1, (n, d)).astype(np.float32)
+    f = shard_feature(feat, N_DEV)
+    labels = jnp.asarray((np.arange(n) % classes)
+                         .reshape(N_DEV, -1).astype(np.int32))
+    bs, fanouts = 4, [3, 2]
+    seeds = np.stack([np.arange(s * 16, s * 16 + bs)
+                      for s in range(N_DEV)]).astype(np.int32)
+    key = jax.random.PRNGKey(1)
+
+    # Routing A/B + fused/split through the full sampler: bit-identical.
+    outs = {}
+    for route in ("sort", "onepass"):
+        for fused in (True, False):
+            samp = DistNeighborSampler(sg, mesh, num_neighbors=fanouts,
+                                       batch_size=bs, seed=0, route=route,
+                                       fused=fused,
+                                       exchange_load_factor=2.0)
+            outs[(route, fused)] = samp.sample_from_nodes(
+                jnp.asarray(seeds), key=key)
+    ref = jax.tree_util.tree_leaves(outs[("sort", False)])
+    for k, out in outs.items():
+        for a, b in zip(ref, jax.tree_util.tree_leaves(out)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # The routing-only breakdown program bench.py times (dist_routing_ms)
+    # compiles and runs under both forced paths with matching results.
+    sys.path.insert(0, REPO)
+    from bench import make_routing_only_fn
+    from glt_tpu.sampler.neighbor_sampler import (
+        hop_widths,
+        max_sampled_nodes,
+    )
+
+    widths = hop_widths(bs, fanouts, None)
+    cap = max_sampled_nodes(bs, fanouts, None)
+    ids = jnp.asarray(rng.integers(0, n, cap).astype(np.int32))
+    vals = {rp: int(make_routing_only_fn(widths, cap, sg.nodes_per_shard,
+                                         1, route=rp)(ids))
+            for rp in ("sort", "onepass")}
+    assert vals["sort"] == vals["onepass"]
+
+    # Fused dist train step (shared routing + fused feature+label
+    # payload) trains to a finite loss on both collective paths.
+    model = GraphSAGE(hidden_features=8, out_features=classes,
+                      num_layers=2, dropout_rate=0.0)
+    tx = optax.adam(1e-2)
+    losses = {}
+    for fused in (True, False):
+        state = init_dist_state(model, tx, sg, f, jax.random.PRNGKey(0),
+                                fanouts, bs)
+        step = make_dist_train_step(model, tx, sg, f, labels, mesh,
+                                    fanouts, bs, fused=fused)
+        for it in range(3):
+            state, loss, acc = step(state, jnp.asarray(seeds),
+                                    jax.random.PRNGKey(it))
+        losses[fused] = float(loss)
+        assert np.isfinite(losses[fused])
+    # Same seeds/keys both ways: the fused payload must not move the loss.
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-6)
