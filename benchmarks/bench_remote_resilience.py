@@ -1,0 +1,120 @@
+"""Remote-sampling resilience overhead: what does recovery actually cost?
+
+The fault-tolerant protocol (seq/ack replay window, reconnect with
+backoff, leases — docs/distributed.md "Fault tolerance") adds bytes and
+bookkeeping to every fetch; this bench puts numbers on both sides:
+
+  * ``epoch_ms_clean``     — remote epoch, no faults: the steady-state
+                             cost of the sequenced protocol itself;
+  * ``epoch_ms_dropweather`` — same epoch with every connection dropped
+                             after K frames (client side), i.e. the
+                             worst sustained reconnect churn that still
+                             makes progress;
+  * ``reconnects``         — connections burned by the faulty epoch;
+  * ``overhead_ms_per_reconnect`` — (dropweather - clean) / reconnects:
+                             the marginal price of one drop+resume.
+
+Every epoch asserts exactly-once delivery (sequence accounting) before
+its timing is trusted — a bench that lost batches would be measuring a
+different protocol.
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/bench_remote_resilience.py
+
+Prints one JSON line.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_ring_dataset(n=240, dim=8):
+    from glt_tpu.data import Dataset
+
+    src = np.repeat(np.arange(n), 2)
+    dst = np.concatenate([[(i + 1) % n, (i + 2) % n] for i in range(n)])
+    feat = np.arange(n, dtype=np.float32)[:, None] * np.ones((1, dim),
+                                                             np.float32)
+    labels = np.arange(n, dtype=np.int32) % 3
+    return (Dataset()
+            .init_graph(np.stack([src, dst]), graph_mode="HOST",
+                        num_nodes=n)
+            .init_node_features(feat)
+            .init_node_labels(labels))
+
+
+def run_epochs(loader, epochs, n):
+    times = []
+    for _ in range(epochs):
+        t0 = time.perf_counter()
+        seen = []
+        for batch in loader:
+            seen.extend(
+                np.asarray(batch.batch)[:batch.batch_size].tolist())
+        times.append((time.perf_counter() - t0) * 1e3)
+        assert sorted(seen) == list(range(n)), "lost/duplicated batches"
+        stats = loader.epoch_stats
+        assert stats["seqs"] == set(range(len(loader)))
+    return float(np.median(times))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=240)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--drop-after-frames", type=int, default=4)
+    args = ap.parse_args()
+
+    from glt_tpu.distributed import (
+        RemoteNeighborLoader,
+        RemoteSamplingWorkerOptions,
+        init_server,
+    )
+    from glt_tpu.testing.faults import FaultPlan
+
+    n = args.nodes
+    opts = RemoteSamplingWorkerOptions(rpc_timeout=30.0, max_retries=16,
+                                       backoff_base=0.005,
+                                       backoff_cap=0.05)
+    srv = init_server(build_ring_dataset(n))
+    out = {"nodes": n, "batch_size": args.batch_size,
+           "drop_after_frames": args.drop_after_frames}
+    try:
+        clean = RemoteNeighborLoader(
+            srv.addr, [2, 2], np.arange(n), batch_size=args.batch_size,
+            worker_options=opts)
+        # Warm once (XLA compiles on the first sampled batch), then time.
+        run_epochs(clean, 1, n)
+        out["epoch_ms_clean"] = round(run_epochs(clean, args.epochs, n), 2)
+        clean.shutdown()
+
+        plan = FaultPlan(drop_after_frames=args.drop_after_frames)
+        faulty = RemoteNeighborLoader(
+            srv.addr, [2, 2], np.arange(n), batch_size=args.batch_size,
+            worker_options=opts, fault_plan=plan)
+        run_epochs(faulty, 1, n)   # warm this producer's sampler too
+        reconnects_before = faulty.conn.reconnects
+        out["epoch_ms_dropweather"] = round(
+            run_epochs(faulty, args.epochs, n), 2)
+        reconnects = faulty.conn.reconnects - reconnects_before
+        out["reconnects"] = reconnects
+        if reconnects:
+            out["overhead_ms_per_reconnect"] = round(
+                max(0.0, (out["epoch_ms_dropweather"]
+                          - out["epoch_ms_clean"]))
+                * args.epochs / reconnects, 3)
+        faulty.shutdown()
+    finally:
+        srv.shutdown()
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

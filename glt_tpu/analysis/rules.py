@@ -828,6 +828,59 @@ class ShadowedJitDonation(Rule):
         return d if d in donors else None
 
 
+# ---------------------------------------------------------------------------
+# GLT007 unbounded-blocking-get
+# ---------------------------------------------------------------------------
+
+@register
+class UnboundedBlockingGet(Rule):
+    """``queue.Queue.get()`` / ``Thread.join()`` that can block forever.
+
+    The distributed hang class: a consumer blocked in a no-timeout
+    ``.get()`` waits forever once its producer thread/process dies between
+    its last put and the get — nothing will ever arrive, and nothing
+    raises.  Same shape for a no-timeout ``.join()`` on a thread wedged on
+    a bounded queue.  Library code must either bound the wait (``timeout=``)
+    or recheck liveness while polling (``channel.base.bounded_get``); a
+    wait proven bounded by construction takes a justified suppression.
+    """
+    name = "unbounded-blocking-get"
+    code = "GLT007"
+    severity = Severity.ERROR
+    description = ("a blocking .get()/.join() call with no timeout and no "
+                   "liveness recheck in the enclosing function")
+
+    # Zero-argument spellings only: dict.get(key), "".join(parts),
+    # thread.join(5) all carry arguments and are not the blocking form.
+    _BLOCKING = {"get", "join"}
+    # A scope that probes peer liveness is running the timeout-and-recheck
+    # pattern; its waits are bounded by the recheck loop.
+    _LIVENESS = {"is_alive", "is_set", "poll"}
+
+    def check(self, module: ModuleInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        regions = [module.tree] + [
+            s.node for s in module.scopes
+            if not isinstance(s.node, ast.Lambda)]
+        for node in regions:
+            calls = [n for n in _walk_own(node)
+                     if isinstance(n, ast.Call)
+                     and isinstance(n.func, ast.Attribute)]
+            if any(c.func.attr in self._LIVENESS for c in calls):
+                continue
+            for call in calls:
+                if (call.func.attr in self._BLOCKING
+                        and not call.args and not call.keywords):
+                    findings.append(self.finding(
+                        module, call,
+                        f".{call.func.attr}() with no timeout and no "
+                        f"liveness check in scope: blocks forever if the "
+                        f"producer/thread died — pass timeout= in a "
+                        f"recheck loop (see channel.base.bounded_get), or "
+                        f"suppress with a bounded-wait justification"))
+        return findings
+
+
 def _iter_const_ints(node: ast.expr) -> Iterator[int]:
     if isinstance(node, ast.Constant) and isinstance(node.value, int):
         yield node.value

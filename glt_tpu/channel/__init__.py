@@ -1,10 +1,19 @@
-from .base import ChannelBase, SampleMessage
+from .base import (
+    ChannelBase,
+    QueueSourceDied,
+    SampleMessage,
+    bounded_get,
+    bounded_put,
+)
 from .serialization import deserialize, serialize, serialized_size
 from .shm_channel import ShmChannel
 
 __all__ = [
     "ChannelBase",
+    "QueueSourceDied",
     "SampleMessage",
+    "bounded_get",
+    "bounded_put",
     "ShmChannel",
     "deserialize",
     "serialize",

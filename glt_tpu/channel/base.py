@@ -8,11 +8,49 @@ from __future__ import annotations
 import queue
 import threading
 from abc import ABC, abstractmethod
-from typing import Dict
+from typing import Callable, Dict, Optional
 
 import numpy as np
 
 SampleMessage = Dict[str, np.ndarray]
+
+
+class QueueSourceDied(RuntimeError):
+    """The producer feeding a queue died with the consumer still waiting.
+
+    Raised by :func:`bounded_get` when its liveness probe turns false and a
+    final drain finds the queue empty — the bounded replacement for the
+    block-forever ``q.get()`` hang (gltlint GLT007).
+    """
+
+
+def bounded_get(q: "queue.Queue",
+                alive: Optional[Callable[[], bool]] = None,
+                poll: float = 0.5,
+                on_wait: Optional[Callable[[], None]] = None):
+    """Get from a queue with bounded waits and a liveness recheck.
+
+    The dual of :func:`bounded_put`: instead of blocking forever on an
+    empty queue, wake every ``poll`` seconds, call ``on_wait`` (lease
+    renewal, heartbeat), and recheck ``alive()``.  When the source is no
+    longer alive the queue is drained one last time (a source's final put
+    races its death) before :class:`QueueSourceDied` is raised — the
+    consumer gets an error, never a hang.
+    """
+    while True:
+        try:
+            return q.get(timeout=poll)
+        except queue.Empty:
+            pass
+        if on_wait is not None:
+            on_wait()
+        if alive is not None and not alive():
+            try:
+                return q.get_nowait()
+            except queue.Empty:
+                raise QueueSourceDied(
+                    "queue source died (or stopped) with the consumer "
+                    "still waiting") from None
 
 
 def bounded_put(q: "queue.Queue", item, stop: threading.Event,

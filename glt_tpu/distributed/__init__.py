@@ -11,6 +11,11 @@ from .dist_context import (
     init_server_context,
     init_worker_group,
 )
+from .dist_client import (
+    RemoteNeighborLoader,
+    RemoteServerConnection,
+    UnknownProducerError,
+)
 from .dist_dataset import DistDataset
 from .dist_loader import (
     DistHeteroNeighborLoader,
@@ -18,6 +23,7 @@ from .dist_loader import (
     DistNeighborLoader,
     DistSubGraphLoader,
 )
+from .dist_server import DistServer, ProtocolError, init_server
 from .sample_message import batch_to_message, message_to_batch
 
 __all__ = [
@@ -26,15 +32,21 @@ __all__ = [
     "DistDataset",
     "DistHeteroNeighborLoader",
     "DistRole",
+    "DistServer",
     "get_context",
     "init_client_context",
+    "init_server",
     "init_server_context",
     "init_worker_group",
     "DistLinkNeighborLoader",
     "DistNeighborLoader",
     "DistSubGraphLoader",
     "MpSamplingWorkerOptions",
+    "ProtocolError",
+    "RemoteNeighborLoader",
     "RemoteSamplingWorkerOptions",
+    "RemoteServerConnection",
+    "UnknownProducerError",
     "batch_to_message",
     "message_to_batch",
 ]

@@ -5,85 +5,250 @@ Rebuild of ``distributed/dist_client.py`` + the pull-based
 asks the server to create a producer, kicks epochs, and prefetches sampled
 messages over the socket with a configurable depth (default 4, matching
 RemoteDistSamplingWorkerOptions, dist_options.py:202-254).
+
+Fault tolerance: a :class:`RemoteServerConnection` is never terminally
+poisoned — retryable failures (timeout, ECONNRESET, EOF, a desynced
+frame) reconnect with exponential backoff + jitter, optionally failing
+over across replica addresses, and the sequenced fetch protocol
+(``seq``/``ack``, dist_server.py) re-delivers exactly the batches lost in
+flight, with duplicate suppression here.  Every batch of an epoch is
+delivered exactly once across arbitrarily many reconnects.
 """
 from __future__ import annotations
 
 import dataclasses
 import json
 import queue
+import random
 import socket
+import struct
 import threading
+import time
+import uuid
 from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..channel.base import bounded_put
+from ..channel.base import QueueSourceDied, bounded_get, bounded_put
 from ..channel.serialization import deserialize
 from ..loader.transform import Batch
-from .dist_server import _KIND_JSON, _KIND_MSG, recv_frame, send_frame
+from .dist_server import (
+    _KIND_JSON,
+    _KIND_MSG,
+    DEFAULT_MAX_FRAME_BYTES,
+    ProtocolError,
+    recv_frame,
+    send_frame,
+)
 from .sample_message import message_to_batch
 
 
+class UnknownProducerError(RuntimeError):
+    """The server does not know this producer id: its lease expired and
+    the reaper GC'd it, it was destroyed, or the connection failed over
+    to a replica that never owned it.  The epoch cannot resume — recreate
+    the producer (or the loader) to continue."""
+
+
 class RemoteServerConnection:
+    """One logical connection to a sampling server (with failover).
+
+    Retryable transport failures trigger reconnect with exponential
+    backoff + deterministic jitter, capped by ``max_retries`` /
+    ``backoff_base`` / ``backoff_cap``; ``fallback_addrs`` extends the
+    connect rotation across replicas.  Structured server errors
+    (``{"error":..., "code":...}``) are NOT retried — they are the
+    server speaking clearly, e.g. :class:`UnknownProducerError` for a
+    GC'd lease.
+    """
+
+    RETRYABLE = (OSError, EOFError, ProtocolError)
+
     def __init__(self, addr: Tuple[str, int],
-                 timeout: Optional[float] = 600.0):
+                 timeout: Optional[float] = 600.0,
+                 max_retries: int = 3,
+                 backoff_base: float = 0.05,
+                 backoff_cap: float = 2.0,
+                 fallback_addrs: Sequence[Tuple[str, int]] = (),
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 fault_plan=None,
+                 seed: int = 0):
         # Bounded waits so a dead server surfaces as an error instead of a
         # hang (the reference's RPC timeouts, dist_options.py rpc_timeout).
-        self.sock = socket.create_connection(addr, timeout=timeout)
-        self.sock.settimeout(timeout)
+        self.timeout = timeout
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self.max_frame_bytes = int(max_frame_bytes)
+        self._addrs = [tuple(addr)] + [tuple(a) for a in fallback_addrs]
+        self._addr_i = 0
+        self._fault_plan = fault_plan
+        # Seeded jitter: reconnect storms decorrelate across clients
+        # (seed with the client rank) while staying reproducible in tests.
+        self._rng = random.Random(seed)
         self._lock = threading.Lock()
-        # A timeout/short-read mid-exchange leaves an unconsumed response
-        # in flight: the framed protocol is desynced and every later
-        # exchange would misparse.  Poison the connection instead.
-        self._broken = False
+        self.sock = None
+        self._broken = True          # no socket yet
+        self.reconnects = 0          # successful re-connections (stats)
+        self._connect()
 
-    def _exchange(self, payload: bytes):
-        with self._lock:
-            if self._broken:
-                raise RuntimeError("connection poisoned by an earlier "
-                                   "timeout/protocol error; reconnect")
+    # -- connection management --------------------------------------------
+    def _connect(self) -> None:
+        """Connect to the first reachable address, starting at the one
+        that last worked (failover rotates only past dead hosts)."""
+        if self.sock is not None:
             try:
-                send_frame(self.sock, _KIND_JSON, payload)
-                kind, data = recv_frame(self.sock)
-            except Exception:
-                self._broken = True
-                raise
-            if kind is None or data is None:
-                # EOF (clean or mid-frame) — the server closed the socket
-                # (e.g. died or dropped us after an error frame).
-                self._broken = True
-                raise RuntimeError("server closed the connection")
-            return kind, data
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+            self._replacing = True
+        last_exc = None
+        for k in range(len(self._addrs)):
+            i = (self._addr_i + k) % len(self._addrs)
+            try:
+                sock = socket.create_connection(self._addrs[i],
+                                                timeout=self.timeout)
+            except OSError as e:
+                last_exc = e
+                continue
+            sock.settimeout(self.timeout)
+            if self._fault_plan is not None:
+                sock = self._fault_plan.wrap(sock)
+            if getattr(self, "_replacing", False):
+                self.reconnects += 1
+                self._replacing = False
+            self.sock = sock
+            self._addr_i = i
+            self._broken = False
+            return
+        raise ConnectionError(
+            f"could not connect to any of {self._addrs}: {last_exc}")
 
-    def request(self, **req) -> dict:
-        kind, data = self._exchange(json.dumps(req).encode())
+    def _sleep_backoff(self, attempt: int,
+                       stop: Optional[threading.Event]) -> None:
+        delay = min(self.backoff_cap, self.backoff_base * (2 ** attempt))
+        delay *= 0.5 + 0.5 * self._rng.random()     # jitter
+        if stop is not None:
+            stop.wait(delay)
+        else:
+            time.sleep(delay)
+
+    def _exchange(self, payload: bytes,
+                  stop: Optional[threading.Event] = None,
+                  retries: Optional[int] = None):
+        retries = self.max_retries if retries is None else int(retries)
+        with self._lock:
+            last_exc = None
+            for attempt in range(retries + 1):
+                # Stop-aware: a shutdown mid-retry surfaces immediately
+                # instead of sleeping out the backoff schedule.
+                if stop is not None and stop.is_set():
+                    raise ConnectionAbortedError(
+                        "exchange stopped by shutdown")
+                if attempt:
+                    self._sleep_backoff(attempt - 1, stop)
+                    if stop is not None and stop.is_set():
+                        raise ConnectionAbortedError(
+                            "exchange stopped by shutdown")
+                try:
+                    if self._broken or self.sock is None:
+                        # A timeout/short-read mid-exchange leaves the
+                        # framed stream desynced; reconnecting is the only
+                        # way to resync it.
+                        self._connect()
+                    send_frame(self.sock, _KIND_JSON, payload)
+                    kind, data = recv_frame(
+                        self.sock, max_len=self.max_frame_bytes)
+                    if kind is None:
+                        # EOF (clean or mid-frame) — the server closed the
+                        # socket (died, or dropped us after an error).
+                        raise ConnectionResetError(
+                            "server closed the connection")
+                    if kind == _KIND_JSON and b'"error"' in data[:64]:
+                        resp = json.loads(data)
+                        if resp.get("code") == "protocol":
+                            # The server saw a desynced/corrupt frame from
+                            # us and is closing: retryable — a fresh
+                            # connection resyncs the framing.
+                            raise ProtocolError(resp.get("error", ""))
+                    return kind, data
+                except self.RETRYABLE as e:
+                    self._broken = True
+                    last_exc = e
+            raise RuntimeError(
+                f"exchange failed after {retries} retries over "
+                f"{self._addrs}: {last_exc}") from last_exc
+
+    @staticmethod
+    def _raise_structured(resp: dict) -> None:
+        if resp.get("code") == "unknown_producer":
+            raise UnknownProducerError(resp["error"])
+        raise RuntimeError(f"server error: {resp['error']}")
+
+    # -- protocol ----------------------------------------------------------
+    def request(self, _stop: Optional[threading.Event] = None,
+                _retries: Optional[int] = None, **req) -> dict:
+        kind, data = self._exchange(json.dumps(req).encode(),
+                                    stop=_stop, retries=_retries)
         if kind != _KIND_JSON:
             raise RuntimeError("expected JSON response")
         resp = json.loads(data)
         if "error" in resp:
-            raise RuntimeError(f"server error: {resp['error']}")
+            self._raise_structured(resp)
         return resp
 
-    def fetch_message(self, producer_id: int):
+    def fetch_message(self, producer_id: int, epoch: int = 0,
+                      ack: int = -1,
+                      stop: Optional[threading.Event] = None):
+        """Fetch one sampled message; returns ``(seq, message)``.
+
+        ``ack`` (highest seq contiguously received) releases the server's
+        replay window and directs resume after a reconnect.
+        """
         kind, data = self._exchange(json.dumps(
             {"op": "fetch_one_sampled_message",
-             "producer_id": producer_id}).encode())
+             "producer_id": producer_id,
+             "epoch": epoch, "ack": ack}).encode(), stop=stop)
         if kind != _KIND_MSG:
-            raise RuntimeError(
-                json.loads(data).get("error", "bad frame"))
-        return deserialize(memoryview(data))
+            resp = json.loads(data)
+            if "error" in resp:
+                self._raise_structured(resp)
+            raise RuntimeError("bad frame")
+        seq = struct.unpack_from("<Q", data, 0)[0]
+        return int(seq), deserialize(memoryview(data)[8:])
 
     @property
     def broken(self) -> bool:
         return self._broken
 
+    def interrupt(self) -> None:
+        """Force-close the socket so a thread blocked inside an exchange
+        raises promptly (and observes its stop event instead of
+        retrying).  The connection transparently reconnects on next use."""
+        self._broken = True
+        try:
+            if self.sock is not None:
+                self.sock.close()
+        except OSError:
+            pass
+
     def close(self) -> None:
-        self.sock.close()
+        try:
+            if self.sock is not None:
+                self.sock.close()
+        except OSError:
+            pass
 
 
 class RemoteNeighborLoader:
     """Loader iterating batches produced on a remote sampling server
-    (the reference's DistLoader 'remote' mode, dist_loader.py:188-217)."""
+    (the reference's DistLoader 'remote' mode, dist_loader.py:188-217).
+
+    After each epoch, ``epoch_stats`` records the sequence-number
+    accounting: ``{"received", "duplicates", "reconnects", "seqs"}`` —
+    the chaos suite asserts exactly-once delivery from it.
+    """
 
     def __init__(
         self,
@@ -94,6 +259,7 @@ class RemoteNeighborLoader:
         prefetch: Optional[int] = None,
         seed: int = 0,
         worker_options=None,
+        fault_plan=None,
     ):
         from .dist_options import RemoteSamplingWorkerOptions
 
@@ -105,8 +271,20 @@ class RemoteNeighborLoader:
         # An explicit ``prefetch`` argument wins over the options default.
         if prefetch is not None:
             opts = dataclasses.replace(opts, prefetch_size=int(prefetch))
-        self.conn = RemoteServerConnection(server_addr,
-                                           timeout=float(opts.rpc_timeout))
+        self.conn = RemoteServerConnection(
+            server_addr,
+            timeout=float(opts.rpc_timeout),
+            max_retries=int(opts.max_retries),
+            backoff_base=float(opts.backoff_base),
+            backoff_cap=float(opts.backoff_cap),
+            fallback_addrs=tuple(opts.fallback_addrs),
+            max_frame_bytes=int(opts.max_frame_bytes),
+            fault_plan=fault_plan,
+            seed=seed)
+        # Stable per-loader identity: a re-create after lease GC (or a
+        # retried create whose response was lost) tears down the previous
+        # producer server-side instead of leaking it.
+        self._client_key = uuid.uuid4().hex
         resp = self.conn.request(
             op="create_sampling_producer",
             num_neighbors=list(num_neighbors),
@@ -115,33 +293,66 @@ class RemoteNeighborLoader:
             seed=seed + opts.worker_seed,
             num_workers=int(opts.num_workers),
             buffer_capacity=int(opts.buffer_capacity),
-            channel_capacity_bytes=int(opts.channel_capacity_bytes))
+            channel_capacity_bytes=int(opts.channel_capacity_bytes),
+            lease_secs=float(opts.lease_secs),
+            replay_window=int(opts.replay_window),
+            client_key=self._client_key)
         self.producer_id = resp["producer_id"]
         self.num_expected = resp["num_expected"]
         self.prefetch = max(1, int(opts.prefetch_size))
+        self._epoch = 0
+        self.epoch_stats: dict = {}
 
     def __len__(self) -> int:
         return self.num_expected
 
     def __iter__(self) -> Iterator[Batch]:
+        self._epoch += 1
+        epoch = self._epoch
         self.conn.request(op="start_new_epoch_sampling",
-                          producer_id=self.producer_id)
+                          producer_id=self.producer_id, epoch=epoch)
         # Bounded to the configured prefetch depth: a slow trainer holds at
         # most ``prefetch`` unconsumed messages instead of buffering the
         # whole epoch in client RAM (the reference's prefetch_size
         # semantics, channel/remote_channel.py:24-85).
         buf: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
+        stats = {"received": 0, "duplicates": 0, "seqs": set()}
+        reconnects_before = self.conn.reconnects
 
         def prefetcher():
-            # A fetch error (dead server, socket timeout) is forwarded to
-            # the consumer instead of dying silently in this thread and
-            # leaving the consumer blocked forever on buf.get().
+            # A fetch error (dead server, socket timeout past the retry
+            # budget, GC'd lease) is forwarded to the consumer instead of
+            # dying silently in this thread and leaving the consumer
+            # blocked forever on buf.get().
             try:
-                for _ in range(self.num_expected):
-                    msg = self.conn.fetch_message(self.producer_id)
+                ack = -1
+                dup_run = 0
+                while len(stats["seqs"]) < self.num_expected:
+                    if stop.is_set():
+                        return
+                    seq, msg = self.conn.fetch_message(
+                        self.producer_id, epoch=epoch, ack=ack, stop=stop)
+                    if seq in stats["seqs"]:
+                        # Duplicate suppression: a replayed message we
+                        # already hold is dropped, but an identical resend
+                        # loop must not spin forever.
+                        stats["duplicates"] += 1
+                        dup_run += 1
+                        if dup_run > 2 * self.num_expected + 16:
+                            raise RuntimeError(
+                                "resume protocol livelock: server keeps "
+                                "resending already-received seqs")
+                        continue
+                    dup_run = 0
+                    stats["seqs"].add(seq)
+                    stats["received"] += 1
+                    while ack + 1 in stats["seqs"]:
+                        ack += 1
                     if not bounded_put(buf, msg, stop):
                         return
+            except ConnectionAbortedError:
+                return   # stop-aware exchange observed the shutdown
             except Exception as e:  # noqa: BLE001 — relayed to consumer
                 bounded_put(buf, e, stop)
 
@@ -149,20 +360,38 @@ class RemoteNeighborLoader:
         t.start()
         try:
             for _ in range(self.num_expected):
-                item = buf.get()
+                try:
+                    item = bounded_get(buf, alive=t.is_alive, poll=0.2)
+                except QueueSourceDied:
+                    raise RuntimeError(
+                        "remote sampling prefetch thread died "
+                        "unexpectedly") from None
                 if isinstance(item, Exception):
                     raise RuntimeError(
                         f"remote sampling prefetch failed: {item}") from item
                 yield message_to_batch(item)
         finally:
             stop.set()
+            # Join the prefetcher: one still blocked inside fetch_message
+            # holds the connection lock, so an un-joined exit would make a
+            # prompt shutdown() (or the next epoch's start request) wait
+            # out rpc_timeout.  If it doesn't exit on its own, force the
+            # socket closed — the blocked recv raises, the stop-aware
+            # retry loop sees `stop`, and the lock is released.
+            t.join(timeout=1.0)
+            if t.is_alive():
+                self.conn.interrupt()
+                t.join(timeout=2.0)
+            stats["reconnects"] = self.conn.reconnects - reconnects_before
+            self.epoch_stats = stats
 
     def shutdown(self, exit_server: bool = False) -> None:
         try:
-            if not self.conn.broken:
-                self.conn.request(op="destroy_sampling_producer",
-                                  producer_id=self.producer_id)
-                if exit_server:
-                    self.conn.request(op="exit")
+            self.conn.request(op="destroy_sampling_producer",
+                              producer_id=self.producer_id, _retries=1)
+            if exit_server:
+                self.conn.request(op="exit", _retries=1)
+        except (RuntimeError, OSError):
+            pass   # unreachable server: the lease reaper collects it
         finally:
             self.conn.close()

@@ -20,6 +20,7 @@ from ..loader.transform import Batch
 from .dist_options import (
     CollocatedSamplingWorkerOptions,
     MpSamplingWorkerOptions,
+    RemoteSamplingWorkerOptions,
 )
 from .dist_sampling_producer import MpSamplingProducer, WORKER_SAMPLER_KWARGS
 from .sample_message import message_to_batch
@@ -57,9 +58,30 @@ class _DistLoaderBase:
         worker_options = worker_options or CollocatedSamplingWorkerOptions()
         self.options = worker_options
         self._inner = None
+        self._remote = None
         self._producer: Optional[MpSamplingProducer] = None
 
-        if isinstance(worker_options, CollocatedSamplingWorkerOptions):
+        if isinstance(worker_options, RemoteSamplingWorkerOptions):
+            # Remote mode by option type (the reference's DistLoader mode
+            # select, dist_loader.py:142-221): producers live on the
+            # sampling server named by ``worker_options.server_addr``;
+            # batches stream back over the fault-tolerant socket protocol.
+            if worker_options.server_addr is None:
+                raise ValueError(
+                    "remote mode requires "
+                    "RemoteSamplingWorkerOptions(server_addr=(host, port))")
+            if self._KIND != "node":
+                raise NotImplementedError(
+                    f"remote mode serves node sampling only (got "
+                    f"{self._KIND!r}); use an mp/collocated loader")
+            from .dist_client import RemoteNeighborLoader
+
+            self._remote = RemoteNeighborLoader(
+                tuple(worker_options.server_addr), num_neighbors,
+                input_seeds, batch_size=batch_size, seed=seed,
+                worker_options=worker_options)
+            self._inner = self._remote
+        elif isinstance(worker_options, CollocatedSamplingWorkerOptions):
             if dataset is None:
                 raise ValueError("collocated mode requires dataset=")
             self._inner = self._make_inner(
@@ -105,6 +127,10 @@ class _DistLoaderBase:
         return self._num_batches
 
     def shutdown(self) -> None:
+        if self._remote is not None:
+            self._remote.shutdown()
+            self._remote = None
+            self._inner = None
         if self._producer is not None:
             self._producer.shutdown()
             self.channel.close()

@@ -52,6 +52,27 @@ class RemoteSamplingWorkerOptions:
       prefetch_size: client-side prefetch depth — at most this many
         fetched-but-unconsumed messages are held by the loader (the
         reference's RemoteReceivingChannel prefetch, remote_channel.py:24).
+      max_retries: retryable transport failures (timeout, ECONNRESET,
+        EOF, desynced frame) per exchange before giving up; each retry
+        reconnects with exponential backoff + jitter.
+      backoff_base / backoff_cap: reconnect backoff schedule, seconds —
+        ``min(cap, base * 2**attempt)`` with 50-100% jitter.
+      fallback_addrs: replica ``(host, port)`` addresses tried when the
+        primary is unreachable (failover for meta/create traffic; a
+        mid-epoch producer cannot migrate, so a failed-over fetch
+        surfaces ``UnknownProducerError``).
+      lease_secs: server-side producer lease; renewed implicitly by any
+        request naming the producer, including every poll of a blocked
+        fetch.  A client that vanishes without destroy leaks nothing —
+        the server reaper GCs the producer (mp fleet + shm segment)
+        once the lease expires.  0 disables expiry.
+      replay_window: sent-but-unacked messages the server retains per
+        producer for resume-after-reconnect.
+      max_frame_bytes: reject protocol frames above this payload size (a
+        corrupt u64 length must not drive an unbounded allocation).
+      server_addr: ``(host, port)`` — only consumed by the worker-mode
+        ``DistNeighborLoader`` front-end to select remote mode by option
+        type; ``RemoteNeighborLoader`` takes the address positionally.
     """
     num_workers: int = 0
     buffer_capacity: int = 8
@@ -63,3 +84,12 @@ class RemoteSamplingWorkerOptions:
     # compile on an oversubscribed host can stall the producer for
     # minutes before the first batch lands.
     rpc_timeout: float = 600.0
+    # -- fault tolerance (see docs/distributed.md "Fault tolerance") ----
+    max_retries: int = 3
+    backoff_base: float = 0.05
+    backoff_cap: float = 2.0
+    fallback_addrs: tuple = ()
+    lease_secs: float = 300.0
+    replay_window: int = 8
+    max_frame_bytes: int = 1 << 30
+    server_addr: tuple = None

@@ -109,6 +109,10 @@ def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
         raise ValueError(f"unknown sampling kind {kind!r}")
 
     while True:
+        # Idle worker awaiting commands: there is no liveness to probe
+        # from here (the parent owns it), and shutdown() sends _CMD_STOP
+        # then terminates stragglers — the wait is bounded by the parent.
+        # gltlint: disable-next=unbounded-blocking-get
         cmd, payload = task_queue.get()
         if cmd == _CMD_STOP:
             break

@@ -11,40 +11,86 @@ the shm channel path, and cross-host bulk data rides the same socket.
 
 Protocol (all frames ``u32 kind | u64 len | payload``):
   kind 0: JSON control request/response
-  kind 1: serialized SampleMessage
+  kind 1: ``u64 seq`` + serialized SampleMessage
+
+Fault tolerance (beyond the reference, SURVEY §5):
+
+* **ack-based resume** — every sampled message carries a per-producer,
+  per-epoch monotonic sequence number; the server retains sent-but-unacked
+  messages in a small replay window, so a client that reconnects after a
+  dropped socket re-fetches exactly the batches it never received
+  (``fetch_one_sampled_message`` carries ``ack``, the highest seq the
+  client has contiguously received).
+* **producer leases** — any request naming a producer renews its lease;
+  a reaper thread GCs producers whose lease expired (mp worker fleet and
+  shm segment included), so a client that crashes without calling
+  ``destroy_sampling_producer`` cannot leak server resources.
+* **structured errors** — recoverable request failures are JSON
+  ``{"error": ..., "code": ...}`` responses on a *still-usable*
+  connection; only protocol desync closes the session.
 """
 from __future__ import annotations
 
+import collections
 import json
 import queue
 import socket
 import struct
 import threading
-from typing import Callable, Dict, Optional, Sequence
+import time
+from typing import Callable, Deque, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..channel.base import bounded_put
+from ..channel.base import QueueSourceDied, bounded_get, bounded_put
 from ..channel.serialization import deserialize, serialize
+from ..testing.faults import FaultPlan, ProducerKilled
 
 _KIND_JSON = 0
 _KIND_MSG = 1
 
+# Reject frames above this many payload bytes unless configured otherwise:
+# a corrupt (or hostile) u64 length must fail the frame, not drive an
+# unbounded allocation.
+DEFAULT_MAX_FRAME_BYTES = 1 << 30
 
-def send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
+DEFAULT_LEASE_SECS = 300.0
+DEFAULT_REPLAY_WINDOW = 8
+
+
+class ProtocolError(RuntimeError):
+    """The framed byte stream is invalid (bad length, truncated header)."""
+
+
+class RequestError(RuntimeError):
+    """A structured, per-request failure: reported to the client as
+    ``{"error": ..., "code": ...}`` without closing the connection, so the
+    client can distinguish e.g. a GC'd producer lease (``unknown_producer``)
+    from a crashed server."""
+
+    def __init__(self, message: str, code: str):
+        super().__init__(message)
+        self.code = code
+
+
+def send_frame(sock, kind: int, payload: bytes) -> None:
     sock.sendall(struct.pack("<IQ", kind, len(payload)) + payload)
 
 
-def recv_frame(sock: socket.socket):
+def recv_frame(sock, max_len: int = DEFAULT_MAX_FRAME_BYTES):
     hdr = _recv_exact(sock, 12)
     if hdr is None:
         return None, None
     kind, length = struct.unpack("<IQ", hdr)
+    if length > max_len:
+        raise ProtocolError(
+            f"frame length {length} exceeds the {max_len}-byte bound "
+            f"(corrupt stream or hostile peer)")
     data = _recv_exact(sock, length)
     return kind, data
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+def _recv_exact(sock, n: int) -> Optional[bytes]:
     buf = b""
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
@@ -65,18 +111,39 @@ class _Producer:
         dist_server.py:83-116), drained into the bounded buffer by a
         forwarder thread.  Requires the server's picklable
         ``dataset_builder``.
+
+    Delivery bookkeeping: buffer items are ``(epoch, payload)`` pairs;
+    ``fetch_next`` assigns each fresh payload a monotonic per-epoch seq,
+    retains the last ``replay_window`` sent-but-unacked payloads for
+    resume-after-reconnect, and re-homes items popped by a stale (dead-
+    connection) reader thread so no batch is ever lost to a race.
     """
 
     def __init__(self, dataset, num_neighbors, input_nodes, batch_size,
                  buffer_capacity: int = 8, seed: int = 0,
                  num_workers: int = 0, dataset_builder=None,
                  builder_args: tuple = (),
-                 channel_capacity_bytes: int = 64 * 1024 * 1024):
+                 channel_capacity_bytes: int = 64 * 1024 * 1024,
+                 lease_secs: float = DEFAULT_LEASE_SECS,
+                 replay_window: int = DEFAULT_REPLAY_WINDOW,
+                 fault_plan: Optional[FaultPlan] = None):
         self.buffer: "queue.Queue" = queue.Queue(maxsize=buffer_capacity)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._mp_producer = None
         self._channel = None
+        self._fault_plan = fault_plan
+        # -- lease -----------------------------------------------------
+        self.lease_secs = float(lease_secs)
+        self.last_active = time.monotonic()
+        # -- sequencing / replay --------------------------------------
+        self.replay_window = max(1, int(replay_window))
+        self._seq_lock = threading.Lock()
+        self._epoch = 0
+        self._next_seq = 0
+        self._retained: Deque[Tuple[int, bytes]] = collections.deque()
+        self._orphans: list = []
+        self._error: Optional[Exception] = None
         if num_workers > 0:
             if dataset_builder is None:
                 raise ValueError(
@@ -108,7 +175,15 @@ class _Producer:
     def num_expected(self) -> int:
         return self._num_expected
 
-    def start_epoch(self) -> None:
+    # -- lease --------------------------------------------------------
+    def touch(self) -> None:
+        self.last_active = time.monotonic()
+
+    def lease_expired(self, now: float) -> bool:
+        return (self.lease_secs > 0
+                and now - self.last_active > self.lease_secs)
+
+    def start_epoch(self, epoch: int = 0) -> None:
         if self._thread is not None:
             # Tell the previous epoch's thread to stop before joining: a
             # client that abandoned its epoch mid-way (early stopping)
@@ -118,7 +193,8 @@ class _Producer:
             self._stop.set()
             self._thread.join(timeout=60)
             if self._thread.is_alive():
-                raise RuntimeError("previous epoch still producing")
+                raise RequestError("previous epoch still producing",
+                                   code="epoch_busy")
         self._stop.clear()
         # Drop anything a previous epoch left behind (in particular a
         # relayed error the client never fetched) so it cannot poison
@@ -128,15 +204,22 @@ class _Producer:
                 self.buffer.get_nowait()
             except queue.Empty:
                 break
+        with self._seq_lock:
+            self._epoch = int(epoch)
+            self._next_seq = 0
+            self._retained.clear()
+            self._orphans.clear()
+            self._error = None
         if self._mp_producer is not None:
             self._mp_producer.produce_all()
             self._thread = threading.Thread(target=self._forward_mp,
-                                            daemon=True)
+                                            args=(int(epoch),), daemon=True)
         else:
-            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread = threading.Thread(target=self._run,
+                                            args=(int(epoch),), daemon=True)
         self._thread.start()
 
-    def _run(self) -> None:
+    def _run(self, epoch: int) -> None:
         from .sample_message import batch_to_message
 
         # Loader failures are relayed to the fetching client (same
@@ -147,28 +230,117 @@ class _Producer:
                 # mid-epoch exits instead of wedging on the bounded buffer
                 # (and permanently poisoning this producer id).
                 if not bounded_put(self.buffer,
-                                   serialize(batch_to_message(batch)),
+                                   (epoch,
+                                    serialize(batch_to_message(batch))),
                                    self._stop):
                     return
+                if self._fault_plan is not None:
+                    self._fault_plan.on_producer_put()
+        except ProducerKilled:
+            # Simulated crash (testing/faults.py): die exactly like an
+            # unexpected thread death — no relay, no cleanup; the fetch
+            # path's liveness recheck is what must surface this.
+            return
         except Exception as e:  # noqa: BLE001 — relayed to client
-            bounded_put(self.buffer, e, self._stop)
+            bounded_put(self.buffer, (epoch, e), self._stop)
 
-    def _forward_mp(self) -> None:
+    def _forward_mp(self, epoch: int) -> None:
         # iter_messages raises after max_respawns of fruitless worker
         # deaths; relay that to the fetching client instead of discarding
         # it in this daemon thread (which would hang the client forever).
         try:
             for msg in self._mp_producer.iter_messages():
-                if not bounded_put(self.buffer, serialize(msg), self._stop):
+                if not bounded_put(self.buffer, (epoch, serialize(msg)),
+                                   self._stop):
                     return
         except Exception as e:  # noqa: BLE001 — relayed to client
-            bounded_put(self.buffer, e, self._stop)
+            bounded_put(self.buffer, (epoch, e), self._stop)
 
-    def fetch(self) -> bytes:
-        item = self.buffer.get()
+    # -- sequenced fetch ----------------------------------------------
+    def _epoch_alive(self) -> bool:
+        t = self._thread
+        return (t is not None and t.is_alive()
+                and not self._stop.is_set())
+
+    def _check_epoch(self, epoch: int) -> None:
+        if epoch != self._epoch:
+            raise RequestError(
+                f"fetch for epoch {epoch} but producer is on epoch "
+                f"{self._epoch}", code="stale_epoch")
+
+    def _pop_current(self, epoch: int):
+        """Pop the next item produced *for this epoch*: orphans first
+        (items a dead connection's reader popped but could not deliver),
+        then the buffer; items left over from an older epoch are dropped."""
+        while True:
+            with self._seq_lock:
+                self._check_epoch(epoch)
+                if self._orphans:
+                    return self._orphans.pop(0)
+            # Bounded wait with a liveness recheck (the GLT007 hang class):
+            # if the epoch thread died between its last put and our get,
+            # the client gets an error, not a blocked connection thread.
+            # Each poll also renews the lease — a client waiting on a slow
+            # batch is an active client.
+            item_epoch, item = bounded_get(
+                self.buffer, alive=self._epoch_alive, poll=0.25,
+                on_wait=self.touch)
+            with self._seq_lock:
+                if item_epoch != self._epoch:
+                    continue       # stale leftover from an older epoch
+                if epoch != self._epoch:
+                    # We are the stale reader: the epoch rolled while we
+                    # were blocked.  Re-home the item for the live epoch.
+                    self._orphans.append(item)
+                    self._check_epoch(epoch)
+            return item
+
+    def fetch_next(self, ack: int, epoch: int) -> Tuple[int, bytes]:
+        """Return ``(seq, payload)`` — the resumable fetch.
+
+        ``ack`` is the highest seq the client has contiguously received:
+        everything at or below it is released from the replay window; the
+        oldest retained seq above it (a message lost in flight on a dead
+        connection) is re-sent before anything fresh is produced, so every
+        batch of an epoch is delivered exactly once across arbitrarily
+        many reconnects.
+        """
+        self.touch()
+        with self._seq_lock:
+            self._check_epoch(epoch)
+            if self._error is not None:
+                # Sticky: a sampling failure survives response loss and
+                # reconnects until the next epoch resets it.
+                raise RequestError(
+                    f"server-side sampling failed: {self._error}",
+                    code="sampling_failed")
+            while self._retained and self._retained[0][0] <= ack:
+                self._retained.popleft()
+            if self._retained:
+                # Sent but never received: resume from the oldest gap.
+                return self._retained[0]
+        try:
+            item = self._pop_current(epoch)
+        except QueueSourceDied:
+            raise RequestError(
+                "producer sampling thread died mid-epoch (or was stopped) "
+                "before delivering every batch; restart the epoch",
+                code="producer_dead") from None
         if isinstance(item, Exception):
-            raise RuntimeError(f"server-side sampling failed: {item}")
-        return item
+            with self._seq_lock:
+                self._error = item
+            raise RequestError(f"server-side sampling failed: {item}",
+                               code="sampling_failed")
+        with self._seq_lock:
+            if epoch != self._epoch:
+                self._orphans.append(item)
+                self._check_epoch(epoch)
+            seq = self._next_seq
+            self._next_seq += 1
+            self._retained.append((seq, item))
+            while len(self._retained) > self.replay_window:
+                self._retained.popleft()
+        return seq, item
 
     def stop(self) -> None:
         self._stop.set()
@@ -194,12 +366,18 @@ class DistServer:
     def __init__(self, dataset, host: str = "127.0.0.1", port: int = 0,
                  dataset_builder=None, builder_args: tuple = (),
                  num_servers: int = 1, server_rank: int = 0,
-                 num_clients: int = 0):
+                 num_clients: int = 0,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 reap_interval: float = 0.25,
+                 fault_plan: Optional[FaultPlan] = None):
         from .dist_context import _set_default, make_server_context
 
         self.dataset = dataset
         self._dataset_builder = dataset_builder
         self._builder_args = builder_args
+        self.max_frame_bytes = int(max_frame_bytes)
+        self._reap_interval = float(reap_interval)
+        self._fault_plan = fault_plan
         # The server's own topology record; installed as the process
         # context only when none exists (several roles can share one
         # process in the single-host test topology — call
@@ -208,6 +386,10 @@ class DistServer:
                                            num_clients)
         _set_default(self.context)
         self._producers: Dict[int, _Producer] = {}
+        # client_key -> producer id: a client that reconnects and
+        # re-creates (its lease expired, or it restarted) first tears
+        # down its previous producer instead of leaking it.
+        self._client_keys: Dict[str, int] = {}
         self._next_id = 0
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -219,6 +401,43 @@ class DistServer:
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
+        # Lease reaper: GCs producers whose client vanished without a
+        # destroy (crash, network partition) — mp fleet + shm included.
+        self._reaper_thread = threading.Thread(target=self._reap_loop,
+                                               daemon=True)
+        self._reaper_thread.start()
+
+    # -- producer bookkeeping ---------------------------------------------
+    def _get_producer(self, req: dict) -> _Producer:
+        pid = req.get("producer_id")
+        with self._lock:
+            prod = self._producers.get(pid)
+        if prod is None:
+            raise RequestError(
+                f"unknown or expired producer id {pid!r} (lease GC'd, "
+                f"destroyed, or never created on this server)",
+                code="unknown_producer")
+        prod.touch()
+        return prod
+
+    def _reap_loop(self) -> None:
+        while not self._stop.wait(self._reap_interval):
+            now = time.monotonic()
+            expired = []
+            with self._lock:
+                for pid in [p for p, prod in self._producers.items()
+                            if prod.lease_expired(now)]:
+                    expired.append((pid, self._producers.pop(pid)))
+                for pid, _ in expired:
+                    for ck in [k for k, v in self._client_keys.items()
+                               if v == pid]:
+                        del self._client_keys[ck]
+            for _, prod in expired:
+                prod.stop()
+
+    def live_producers(self) -> int:
+        with self._lock:
+            return len(self._producers)
 
     # -- request handlers (cf. _call_func_on_server, dist_server.py:214) ---
     def _handle(self, req: dict):
@@ -242,19 +461,37 @@ class DistServer:
                 dataset_builder=self._dataset_builder,
                 builder_args=self._builder_args,
                 channel_capacity_bytes=req.get(
-                    "channel_capacity_bytes", 64 * 1024 * 1024))
+                    "channel_capacity_bytes", 64 * 1024 * 1024),
+                lease_secs=req.get("lease_secs", DEFAULT_LEASE_SECS),
+                replay_window=req.get("replay_window",
+                                      DEFAULT_REPLAY_WINDOW),
+                fault_plan=self._fault_plan)
+            client_key = req.get("client_key")
+            stale = None
             with self._lock:
                 pid = self._next_id
                 self._next_id += 1
                 self._producers[pid] = prod
+                if client_key:
+                    old = self._client_keys.get(client_key)
+                    if old is not None:
+                        stale = self._producers.pop(old, None)
+                    self._client_keys[client_key] = pid
+            if stale is not None:
+                # Same client re-created (reconnect after lease GC raced,
+                # or a restart): its previous fleet must not leak.
+                stale.stop()
             return {"producer_id": pid,
                     "num_expected": prod.num_expected()}
         if op == "start_new_epoch_sampling":
-            self._producers[req["producer_id"]].start_epoch()
+            self._get_producer(req).start_epoch(int(req.get("epoch", 0)))
             return {"ok": True}
         if op == "destroy_sampling_producer":
             with self._lock:
                 prod = self._producers.pop(req["producer_id"], None)
+                for ck in [k for k, v in self._client_keys.items()
+                           if v == req["producer_id"]]:
+                    del self._client_keys[ck]
             if prod is not None:
                 prod.stop()
             return {"ok": True}
@@ -275,23 +512,41 @@ class DistServer:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
-    def _serve_conn(self, conn: socket.socket) -> None:
+    def _serve_conn(self, conn) -> None:
+        if self._fault_plan is not None:
+            conn = self._fault_plan.wrap(conn)
         try:
             while True:
-                kind, data = recv_frame(conn)
+                kind, data = recv_frame(conn, max_len=self.max_frame_bytes)
                 if kind is None:
                     return
                 req = json.loads(data)
-                if req["op"] == "fetch_one_sampled_message":
-                    payload = self._producers[req["producer_id"]].fetch()
-                    send_frame(conn, _KIND_MSG, payload)
-                else:
-                    resp = self._handle(req)
-                    send_frame(conn, _KIND_JSON, json.dumps(resp).encode())
-        except Exception as e:  # connection-scoped errors end the session
+                try:
+                    if req["op"] == "fetch_one_sampled_message":
+                        prod = self._get_producer(req)
+                        seq, payload = prod.fetch_next(
+                            int(req.get("ack", -1)),
+                            int(req.get("epoch", 0)))
+                        send_frame(conn, _KIND_MSG,
+                                   struct.pack("<Q", seq) + payload)
+                    else:
+                        resp = self._handle(req)
+                        send_frame(conn, _KIND_JSON,
+                                   json.dumps(resp).encode())
+                except RequestError as e:
+                    # Structured per-request failure: report it and keep
+                    # the connection serving — the framed stream is still
+                    # in sync.
+                    send_frame(conn, _KIND_JSON, json.dumps(
+                        {"error": str(e), "code": e.code}).encode())
+        except Exception as e:  # desync/socket errors end the session
+            # "protocol" marks a desynced stream: the client treats it as
+            # retryable (reconnect resyncs framing, the replay window
+            # resumes delivery); anything else is a terminal server error.
+            code = "protocol" if isinstance(e, ProtocolError) else "fatal"
             try:
-                send_frame(conn, _KIND_JSON,
-                           json.dumps({"error": str(e)}).encode())
+                send_frame(conn, _KIND_JSON, json.dumps(
+                    {"error": str(e), "code": code}).encode())
             except OSError:
                 pass
         finally:
@@ -308,6 +563,7 @@ class DistServer:
         with self._lock:
             producers = list(self._producers.values())
             self._producers.clear()
+            self._client_keys.clear()
         for prod in producers:
             prod.stop()
         try:
@@ -319,7 +575,10 @@ class DistServer:
 def init_server(dataset, host: str = "127.0.0.1", port: int = 0,
                 dataset_builder=None, builder_args: tuple = (),
                 num_servers: int = 1, server_rank: int = 0,
-                num_clients: int = 0) -> DistServer:
+                num_clients: int = 0,
+                max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                reap_interval: float = 0.25,
+                fault_plan: Optional[FaultPlan] = None) -> DistServer:
     """Start a sampling server (cf. init_server, dist_server.py:158-190).
 
     Pass a picklable ``dataset_builder`` (+``builder_args``) to enable
@@ -327,9 +586,16 @@ def init_server(dataset, host: str = "127.0.0.1", port: int = 0,
     ``RemoteSamplingWorkerOptions(num_workers > 0)``.
     ``num_servers``/``server_rank``/``num_clients`` record the fleet
     topology in this process's :class:`~.dist_context.DistContext`.
+    ``max_frame_bytes`` bounds inbound frame payloads (protocol error
+    beyond it); ``fault_plan`` wires a deterministic
+    :class:`~glt_tpu.testing.faults.FaultPlan` into every accepted
+    connection and producer thread (chaos testing only).
     """
     return DistServer(dataset, host=host, port=port,
                       dataset_builder=dataset_builder,
                       builder_args=builder_args,
                       num_servers=num_servers, server_rank=server_rank,
-                      num_clients=num_clients)
+                      num_clients=num_clients,
+                      max_frame_bytes=max_frame_bytes,
+                      reap_interval=reap_interval,
+                      fault_plan=fault_plan)

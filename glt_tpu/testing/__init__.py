@@ -1,0 +1,12 @@
+"""Deterministic test harnesses shipped with the library.
+
+``glt_tpu.testing.faults`` drives the fault-tolerance chaos suite: a
+:class:`~glt_tpu.testing.faults.FaultPlan` injects socket drops, delayed
+or corrupted frames, and producer-thread deaths into the remote sampling
+protocol at exact, reproducible points — every recovery path in
+``glt_tpu/distributed`` is testable without flaky sleeps or real network
+weather.
+"""
+from .faults import FaultPlan, FaultyConnection, ProducerKilled
+
+__all__ = ["FaultPlan", "FaultyConnection", "ProducerKilled"]

@@ -1,0 +1,182 @@
+"""Deterministic fault injection for the remote sampling protocol.
+
+A :class:`FaultPlan` is a reproducible schedule of failures — *the Nth
+frame write fails*, *every connection drops after K frames*, *the 3rd
+frame is delayed past the RPC timeout*, *the producer thread dies after
+2 batches* — injectable into both socket endpoints
+(:class:`~glt_tpu.distributed.dist_server.DistServer` wraps accepted
+connections, :class:`~glt_tpu.distributed.dist_client.RemoteServerConnection`
+wraps its outbound socket) and into the server-side ``_Producer`` epoch
+thread.  ``tests/test_fault_tolerance.py`` drives one plan per failure
+class and asserts exactly-once delivery (or a bounded, structured error)
+under each.
+
+Everything is counter-based and lock-protected: the same plan against the
+same workload injects at the same protocol step every run — no sleeps
+racing the scheduler, no flaky "usually drops around batch 3".
+"""
+from __future__ import annotations
+
+import dataclasses
+import socket
+import struct
+import threading
+import time
+from typing import Optional, Tuple
+
+# Length written into a corrupted frame header: far above any configured
+# frame bound, so the receiver rejects it before allocating.
+_CORRUPT_LEN = 1 << 62
+
+
+class ProducerKilled(BaseException):
+    """Simulated crash of a server-side sampling thread.
+
+    Deliberately a ``BaseException``: the producer's relay-to-client
+    ``except Exception`` must NOT turn this into a clean error message —
+    the thread has to die the way a real crash kills it (no relay, no
+    cleanup), so the fetch path's liveness recheck is what surfaces it.
+    """
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """One deterministic fault schedule.
+
+    Frame indices are 1-based and count frame *writes* through faulty
+    endpoints, globally across connections (``fail_nth_frame``,
+    ``corrupt_length_frame``, ``delay_frames``) or per connection
+    (``drop_after_frames``).  A plan is mutable shared state: hand the
+    same instance to the endpoint under test and read the ``injected_*``
+    counters back in assertions.
+    """
+
+    # Close the transport once this many frames were carried by a
+    # connection — the K+1th write finds a dead socket (ECONNRESET-class).
+    drop_after_frames: Optional[int] = None
+    # Raise ``fail_exc`` instead of performing the Nth frame write.
+    fail_nth_frame: Optional[int] = None
+    fail_exc: type = ConnectionResetError
+    # Sleep ``delay_secs`` before each listed frame write (simulates a
+    # stall long enough to trip the peer's rpc_timeout).
+    delay_frames: Tuple[int, ...] = ()
+    delay_secs: float = 0.0
+    # Overwrite the u64 length field of the Nth frame write with a huge
+    # value — the hostile/corrupt-header case recv_frame must reject.
+    corrupt_length_frame: Optional[int] = None
+    # Kill the server-side producer epoch thread after this many buffer
+    # puts (via ProducerKilled, so it dies unrelayed).
+    kill_producer_after_puts: Optional[int] = None
+    # Only the first N accepted/established connections are faulty;
+    # later ones run clean (lets a test end the weather deterministically).
+    max_faulty_conns: Optional[int] = None
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+        self._frames_total = 0
+        self._conns = 0
+        self._puts = 0
+        self.injected_drops = 0
+        self.injected_failures = 0
+        self.injected_corruptions = 0
+        self.injected_delays = 0
+
+    # -- endpoint hooks ----------------------------------------------------
+    def wrap(self, sock: socket.socket):
+        """Wrap one endpoint's socket; returns it unwrapped once
+        ``max_faulty_conns`` connections have been made faulty."""
+        with self._lock:
+            self._conns += 1
+            idx = self._conns
+        if self.max_faulty_conns is not None and idx > self.max_faulty_conns:
+            return sock
+        return FaultyConnection(sock, self, idx)
+
+    def on_producer_put(self) -> None:
+        """Called by the producer epoch thread after each buffer put."""
+        if self.kill_producer_after_puts is None:
+            return
+        with self._lock:
+            self._puts += 1
+            fire = self._puts == self.kill_producer_after_puts
+        if fire:
+            raise ProducerKilled(
+                f"fault injection: producer thread killed after "
+                f"{self.kill_producer_after_puts} puts")
+
+    @property
+    def connections(self) -> int:
+        with self._lock:
+            return self._conns
+
+    # -- internal ----------------------------------------------------------
+    def _frame_action(self, conn: "FaultyConnection") -> Optional[str]:
+        with self._lock:
+            self._frames_total += 1
+            n = self._frames_total
+            if (self.drop_after_frames is not None
+                    and conn._frames >= self.drop_after_frames):
+                self.injected_drops += 1
+                return "drop"
+            if self.fail_nth_frame is not None and n == self.fail_nth_frame:
+                self.injected_failures += 1
+                return "fail"
+            if (self.corrupt_length_frame is not None
+                    and n == self.corrupt_length_frame):
+                self.injected_corruptions += 1
+                return "corrupt"
+            if n in self.delay_frames:
+                self.injected_delays += 1
+                return "delay"
+        return None
+
+
+class FaultyConnection:
+    """Socket wrapper injecting a :class:`FaultPlan` at frame writes.
+
+    Duck-types the subset of the socket API the framed protocol uses
+    (``sendall``/``recv``/``settimeout``/``close``); everything else
+    delegates.  Faults act on writes because both protocol directions
+    have a writer — wrap the client to perturb requests, the server to
+    perturb responses — and a dropped/failed write is observed by the
+    peer as EOF mid-frame, the same desync real network failures cause.
+    """
+
+    def __init__(self, sock: socket.socket, plan: FaultPlan,
+                 conn_index: int):
+        self._sock = sock
+        self._plan = plan
+        self.conn_index = conn_index
+        self._frames = 0
+
+    def sendall(self, data: bytes) -> None:
+        action = self._plan._frame_action(self)
+        if action == "drop":
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._sock.close()
+            raise ConnectionResetError(
+                "fault injection: connection dropped")
+        if action == "fail":
+            raise self._plan.fail_exc("fault injection: frame write failed")
+        if action == "delay":
+            time.sleep(self._plan.delay_secs)
+        elif action == "corrupt":
+            data = bytes(data[:4]) + struct.pack("<Q", _CORRUPT_LEN) \
+                + bytes(data[12:])
+        self._frames += 1
+        self._sock.sendall(data)
+
+    def recv(self, n: int) -> bytes:
+        return self._sock.recv(n)
+
+    def settimeout(self, t) -> None:
+        self._sock.settimeout(t)
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def __getattr__(self, name):
+        return getattr(self._sock, name)

@@ -424,6 +424,80 @@ class TestShadowedJitDonation:
 
 
 # ---------------------------------------------------------------------------
+# GLT007 unbounded-blocking-get
+# ---------------------------------------------------------------------------
+
+class TestUnboundedBlockingGet:
+    def test_positive_bare_queue_get(self):
+        src = """
+        import queue
+
+        def consume(q):
+            item = q.get()          # blocks forever if producer died
+            return item
+        """
+        hits = findings_for(src, "unbounded-blocking-get")
+        assert len(hits) == 1
+        assert ".get()" in hits[0].message
+
+    def test_positive_bare_thread_join(self):
+        src = """
+        import threading
+
+        def stop(worker):
+            worker.stop_flag = True
+            worker.thread.join()    # thread may be wedged on a queue
+        """
+        assert len(findings_for(src, "unbounded-blocking-get")) == 1
+
+    def test_negative_timeout_kwarg(self):
+        src = """
+        def consume(q):
+            return q.get(timeout=0.5)
+
+        def stop(t):
+            t.join(5)
+        """
+        assert findings_for(src, "unbounded-blocking-get") == []
+
+    def test_negative_liveness_recheck_in_scope(self):
+        src = """
+        import queue
+
+        def consume(q, thread):
+            while True:
+                try:
+                    return q.get(timeout=0.5)
+                except queue.Empty:
+                    if not thread.is_alive():
+                        raise RuntimeError("producer died")
+        """
+        assert findings_for(src, "unbounded-blocking-get") == []
+
+    def test_negative_argful_get_join_are_not_blocking(self):
+        src = """
+        import os
+
+        def lookup(d, parts):
+            root = os.environ.get("ROOT")
+            return d.get(root), ",".join(parts)
+        """
+        assert findings_for(src, "unbounded-blocking-get") == []
+
+    def test_suppression_with_justification(self):
+        src = """
+        def worker_loop(tasks):
+            while True:
+                # Parent owns this worker's lifetime; wait is bounded.
+                # gltlint: disable-next=unbounded-blocking-get
+                cmd = tasks.get()
+                if cmd is None:
+                    return
+        """
+        assert findings_for(src, "unbounded-blocking-get") == []
+
+
+# ---------------------------------------------------------------------------
 # suppression / report plumbing
 # ---------------------------------------------------------------------------
 
@@ -476,7 +550,7 @@ def test_rule_registry_complete():
     assert set(RULES) == {
         "host-sync-in-jit", "prng-key-reuse", "recompile-hazard",
         "int64-id-truncation", "nondeterministic-default-rng",
-        "shadowed-jit-donation",
+        "shadowed-jit-donation", "unbounded-blocking-get",
     }
 
 
@@ -513,5 +587,5 @@ def test_cli_list_rules():
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0
     for code in ("GLT001", "GLT002", "GLT003", "GLT004", "GLT005",
-                 "GLT006"):
+                 "GLT006", "GLT007"):
         assert code in proc.stdout

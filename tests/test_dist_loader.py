@@ -99,6 +99,50 @@ def test_mp_worker_death_mid_epoch():
         loader.shutdown()
 
 
+def test_mp_producer_drain_reissue_exactly_once():
+    """Direct coverage of MpSamplingProducer.iter_messages worker-death
+    handling (dist_sampling_producer.py:244-299): SIGKILL a worker while
+    its batches may still sit in the shm ring — the drain loop must yield
+    those in-flight batches (never reissue them), and the respawned worker
+    must produce exactly the undelivered batch-aligned remainder.  Every
+    batch of the epoch arrives exactly once."""
+    import time
+
+    from glt_tpu.channel import ShmChannel
+    from glt_tpu.distributed.dist_sampling_producer import (
+        MpSamplingProducer,
+    )
+
+    n = 48
+    channel = ShmChannel(capacity_bytes=1 << 20)
+    prod = MpSamplingProducer(
+        build_ring_dataset, (n,), [2, 2], np.arange(n), 4,
+        MpSamplingWorkerOptions(num_workers=2, heartbeat_secs=0.5),
+        channel, shuffle=False, seed=0)
+    prod.init()
+    try:
+        prod.produce_all()
+        it = prod.iter_messages()
+        msgs = [next(it)]
+        # Let the ring accumulate in-flight batches so the kill exercises
+        # the drain path, not just the reissue path.
+        deadline = time.monotonic() + 5.0
+        while channel.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        os.kill(prod._workers[0].pid, signal.SIGKILL)
+        msgs.extend(it)
+        assert len(msgs) == prod.num_expected()
+        seen = []
+        for m in msgs:
+            b = message_to_batch(m)
+            check_batch(b, n)
+            seen.extend(np.asarray(b.batch)[:b.batch_size].tolist())
+        assert sorted(seen) == list(range(n))
+    finally:
+        prod.shutdown()
+        channel.close()
+
+
 def test_mp_worker_mode():
     loader = DistNeighborLoader(
         [2, 2], np.arange(N), batch_size=6,

@@ -1,0 +1,434 @@
+"""Chaos suite for the remote sampling service (ISSUE 4 tentpole).
+
+Each test injects one deterministic :class:`~glt_tpu.testing.faults.FaultPlan`
+into a socket endpoint or the server-side producer thread, then asserts the
+contract: a remote epoch completes with **every batch delivered exactly
+once** (sequence-number accounting), or — where recovery is impossible by
+construction (crashed producer thread, GC'd lease) — a **clear structured
+error within bounded time**.  No test may hang: every wait here is bounded
+by small rpc timeouts and retry budgets.
+"""
+import os
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from glt_tpu.distributed import (
+    RemoteNeighborLoader,
+    RemoteSamplingWorkerOptions,
+    RemoteServerConnection,
+    UnknownProducerError,
+    init_server,
+)
+from glt_tpu.distributed.dist_server import (
+    _KIND_JSON,
+    ProtocolError,
+    recv_frame,
+    send_frame,
+)
+from glt_tpu.testing.faults import FaultPlan
+from tests.test_dist_loader import N, build_ring_dataset, check_batch
+
+# Small, snappy settings: chaos tests must fail fast, never hang.
+FAST = dict(rpc_timeout=5.0, max_retries=8, backoff_base=0.01,
+            backoff_cap=0.1)
+
+
+def run_epoch(loader):
+    """Consume one epoch; return the seed ids seen (with multiplicity)."""
+    seen = []
+    for batch in loader:
+        check_batch(batch)
+        seen.extend(np.asarray(batch.batch)[:batch.batch_size].tolist())
+    return seen
+
+
+def assert_exactly_once(loader, seen):
+    assert sorted(seen) == list(range(N))
+    stats = loader.epoch_stats
+    assert stats["received"] == len(loader)
+    assert stats["seqs"] == set(range(len(loader)))
+
+
+# ---------------------------------------------------------------------------
+# Frame bounds (satellite: recv_frame must reject hostile/corrupt lengths)
+# ---------------------------------------------------------------------------
+
+def test_recv_frame_rejects_oversize_length():
+    a, b = socket.socketpair()
+    try:
+        # A corrupt/hostile u64 length must raise, not allocate 2**62 B.
+        a.sendall(struct.pack("<IQ", _KIND_JSON, 1 << 62))
+        with pytest.raises(ProtocolError, match="exceeds"):
+            recv_frame(b, max_len=1 << 20)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_server_rejects_oversize_frame():
+    srv = init_server(build_ring_dataset(), max_frame_bytes=1 << 16)
+    try:
+        raw = socket.create_connection(srv.addr, timeout=5)
+        raw.settimeout(5)
+        try:
+            raw.sendall(struct.pack("<IQ", _KIND_JSON, 1 << 40))
+            kind, data = recv_frame(raw)
+            # The server reports the protocol error, then closes.
+            assert kind == _KIND_JSON
+            assert b"exceeds" in data
+            assert raw.recv(1) == b""
+        finally:
+            raw.close()
+        # The server survives and keeps serving well-formed clients.
+        conn = RemoteServerConnection(srv.addr, timeout=5)
+        assert conn.request(op="get_dataset_meta")["num_nodes"] == N
+        conn.close()
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: drop-connection-after-K-frames (both endpoints)
+# ---------------------------------------------------------------------------
+
+def test_drop_after_k_frames_client_side():
+    """Every client connection dies after 2 request frames; the epoch
+    still delivers every batch exactly once across the reconnects."""
+    srv = init_server(build_ring_dataset())
+    plan = FaultPlan(drop_after_frames=2)
+    loader = RemoteNeighborLoader(
+        srv.addr, [2, 2], np.arange(N), batch_size=2,
+        worker_options=RemoteSamplingWorkerOptions(**FAST),
+        fault_plan=plan)
+    try:
+        seen = run_epoch(loader)
+        assert_exactly_once(loader, seen)
+        assert loader.epoch_stats["reconnects"] >= 1
+        assert plan.injected_drops >= 1
+    finally:
+        loader.shutdown()
+        srv.shutdown()
+
+
+def test_drop_after_k_frames_server_side():
+    """Every server connection dies after 3 response frames — responses
+    are lost *after* the batch was popped and sequenced, so this is the
+    replay window doing the recovery (resume, not re-sample)."""
+    plan = FaultPlan(drop_after_frames=3)
+    srv = init_server(build_ring_dataset(), fault_plan=plan)
+    loader = RemoteNeighborLoader(
+        srv.addr, [2, 2], np.arange(N), batch_size=2,
+        worker_options=RemoteSamplingWorkerOptions(**FAST))
+    try:
+        seen = run_epoch(loader)
+        assert_exactly_once(loader, seen)
+        assert loader.epoch_stats["reconnects"] >= 1
+        assert plan.injected_drops >= 1
+    finally:
+        loader.shutdown()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: fail-Nth-call
+# ---------------------------------------------------------------------------
+
+def test_fail_nth_frame():
+    srv = init_server(build_ring_dataset())
+    plan = FaultPlan(fail_nth_frame=4, fail_exc=ConnectionResetError)
+    loader = RemoteNeighborLoader(
+        srv.addr, [2, 2], np.arange(N), batch_size=2,
+        worker_options=RemoteSamplingWorkerOptions(**FAST),
+        fault_plan=plan)
+    try:
+        seen = run_epoch(loader)
+        assert_exactly_once(loader, seen)
+        assert plan.injected_failures == 1
+    finally:
+        loader.shutdown()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: delayed frame past the rpc timeout
+# ---------------------------------------------------------------------------
+
+def test_delayed_frame_past_timeout():
+    """A server response stalled past rpc_timeout looks like a dead server
+    to the client: it reconnects and the stalled batch is re-delivered
+    from the replay window — exactly once."""
+    plan = FaultPlan(delay_frames=(4,), delay_secs=2.0)
+    srv = init_server(build_ring_dataset(), fault_plan=plan)
+    loader = RemoteNeighborLoader(
+        srv.addr, [2, 2], np.arange(N), batch_size=2,
+        worker_options=RemoteSamplingWorkerOptions(
+            rpc_timeout=0.4, max_retries=8, backoff_base=0.01,
+            backoff_cap=0.1))
+    try:
+        seen = run_epoch(loader)
+        assert_exactly_once(loader, seen)
+        assert plan.injected_delays == 1
+        assert loader.epoch_stats["reconnects"] >= 1
+    finally:
+        loader.shutdown()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: corrupt frame length
+# ---------------------------------------------------------------------------
+
+def test_corrupt_frame_length_recovers():
+    """A corrupted length field desyncs the stream; the receiver rejects
+    the frame (bounded allocation), the session dies, and the client
+    resumes on a fresh connection."""
+    srv = init_server(build_ring_dataset())
+    plan = FaultPlan(corrupt_length_frame=5)
+    loader = RemoteNeighborLoader(
+        srv.addr, [2, 2], np.arange(N), batch_size=2,
+        worker_options=RemoteSamplingWorkerOptions(**FAST),
+        fault_plan=plan)
+    try:
+        seen = run_epoch(loader)
+        assert_exactly_once(loader, seen)
+        assert plan.injected_corruptions == 1
+    finally:
+        loader.shutdown()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: killed producer thread -> bounded structured error + restart
+# ---------------------------------------------------------------------------
+
+def test_killed_producer_thread_bounded_error_and_restart():
+    """The epoch thread dying between puts must surface as a clear error
+    within bounded time (timeout-and-recheck in the fetch path, not a
+    hang), and the producer must accept a fresh epoch afterwards."""
+    plan = FaultPlan(kill_producer_after_puts=2)
+    srv = init_server(build_ring_dataset(), fault_plan=plan)
+    loader = RemoteNeighborLoader(
+        srv.addr, [2, 2], np.arange(N), batch_size=2,
+        worker_options=RemoteSamplingWorkerOptions(**FAST))
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="thread died"):
+            run_epoch(loader)
+        assert time.monotonic() - t0 < 15.0
+        # The next epoch runs clean (the kill is single-shot) and must
+        # deliver everything: the dead epoch did not poison the producer.
+        seen = run_epoch(loader)
+        assert_exactly_once(loader, seen)
+    finally:
+        loader.shutdown()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Producer leases
+# ---------------------------------------------------------------------------
+
+def test_lease_expiry_gc_and_unknown_producer_signal():
+    """A client that vanishes without destroy leaves zero live producers
+    once its lease expires; a later fetch from the zombie loader gets the
+    structured unknown_producer error (not a crash, not a hang)."""
+    srv = init_server(build_ring_dataset(), reap_interval=0.1)
+    loader = RemoteNeighborLoader(
+        srv.addr, [2, 2], np.arange(N), batch_size=6,
+        worker_options=RemoteSamplingWorkerOptions(
+            lease_secs=0.6, **FAST))
+    try:
+        assert_exactly_once(loader, run_epoch(loader))
+        assert srv.live_producers() == 1
+        # Client "crashes": the socket just goes away, no destroy.
+        loader.conn.close()
+        deadline = time.monotonic() + 5.0
+        while srv.live_producers() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert srv.live_producers() == 0
+        # The reconnecting zombie gets a distinguishable, structured error.
+        with pytest.raises(RuntimeError, match="unknown_producer|unknown "
+                                               "or expired"):
+            run_epoch(loader)
+    finally:
+        loader.conn.close()
+        srv.shutdown()
+
+
+def test_lease_renewed_by_activity():
+    """Steady fetching keeps a short lease alive: renewal is implicit in
+    every request (and in every poll of a blocked fetch)."""
+    srv = init_server(build_ring_dataset(), reap_interval=0.1)
+    loader = RemoteNeighborLoader(
+        srv.addr, [2, 2], np.arange(N), batch_size=2,
+        worker_options=RemoteSamplingWorkerOptions(
+            lease_secs=0.8, **FAST))
+    try:
+        for _ in range(2):   # ~several lease lifetimes of activity
+            assert_exactly_once(loader, run_epoch(loader))
+            assert srv.live_producers() == 1
+    finally:
+        loader.shutdown()
+        srv.shutdown()
+
+
+@pytest.mark.slow
+def test_lease_gc_mp_fleet_and_shm():
+    """Lease GC of an mp-backed producer reclaims the whole estate: the
+    worker processes die and the shm segment is unlinked — a crashed
+    client leaks nothing for the life of the server."""
+    srv = init_server(build_ring_dataset(),
+                      dataset_builder=build_ring_dataset,
+                      reap_interval=0.2)
+    loader = RemoteNeighborLoader(
+        srv.addr, [2, 2], np.arange(N), batch_size=6,
+        worker_options=RemoteSamplingWorkerOptions(
+            num_workers=2, channel_capacity_bytes=1 << 20,
+            lease_secs=1.0, **FAST))
+    try:
+        assert_exactly_once(loader, run_epoch(loader))
+        [prod] = list(srv._producers.values())
+        workers = list(prod._mp_producer._workers)
+        shm_name = prod._channel.name.lstrip("/")
+        assert workers and all(p.is_alive() for p in workers)
+        assert shm_name in os.listdir("/dev/shm")
+        loader.conn.close()          # vanish without destroy
+        deadline = time.monotonic() + 30.0
+        while srv.live_producers() and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert srv.live_producers() == 0
+        for p in workers:
+            p.join(timeout=10)
+        assert not any(p.is_alive() for p in workers)
+        assert shm_name not in os.listdir("/dev/shm")
+    finally:
+        loader.conn.close()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Structured errors / reconnect plumbing
+# ---------------------------------------------------------------------------
+
+def test_unknown_producer_keeps_connection_alive():
+    srv = init_server(build_ring_dataset())
+    conn = RemoteServerConnection(srv.addr, timeout=5)
+    try:
+        with pytest.raises(UnknownProducerError):
+            conn.fetch_message(producer_id=12345, epoch=1)
+        # Structured error: the framed stream stayed in sync, the same
+        # connection keeps working, no reconnect happened.
+        assert conn.request(op="get_dataset_meta")["num_nodes"] == N
+        assert conn.reconnects == 0
+    finally:
+        conn.close()
+        srv.shutdown()
+
+
+def test_stale_epoch_structured_error():
+    srv = init_server(build_ring_dataset())
+    conn = RemoteServerConnection(srv.addr, timeout=5)
+    try:
+        resp = conn.request(op="create_sampling_producer",
+                            num_neighbors=[2], input_nodes=list(range(N)),
+                            batch_size=6)
+        pid = resp["producer_id"]
+        conn.request(op="start_new_epoch_sampling", producer_id=pid,
+                     epoch=2)
+        with pytest.raises(RuntimeError, match="stale|epoch"):
+            conn.fetch_message(producer_id=pid, epoch=1)
+    finally:
+        conn.close()
+        srv.shutdown()
+
+
+def test_failover_to_fallback_addr():
+    """Primary down at connect time: the connection fails over to a
+    replica from fallback_addrs instead of dying."""
+    # Grab a port that is guaranteed closed.
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_addr = probe.getsockname()
+    probe.close()
+    srv = init_server(build_ring_dataset())
+    conn = RemoteServerConnection(dead_addr, timeout=5,
+                                  fallback_addrs=[srv.addr])
+    try:
+        assert conn.request(op="get_dataset_meta")["num_nodes"] == N
+    finally:
+        conn.close()
+        srv.shutdown()
+
+
+def test_abandoned_epoch_prompt_shutdown():
+    """Abandoning an epoch mid-way must not pin the connection lock until
+    rpc_timeout: the prefetcher is joined (and its blocked exchange
+    interrupted), so shutdown and the next epoch are prompt."""
+    srv = init_server(build_ring_dataset())
+    loader = RemoteNeighborLoader(
+        srv.addr, [2], np.arange(N), batch_size=2,
+        worker_options=RemoteSamplingWorkerOptions(
+            prefetch_size=1, buffer_capacity=1, rpc_timeout=600.0))
+    try:
+        it = iter(loader)
+        check_batch(next(it))
+        t0 = time.monotonic()
+        it.close()                       # abandon: prefetcher mid-fetch
+        seen = run_epoch(loader)         # fresh epoch, no lock deadlock
+        assert sorted(seen) == list(range(N))
+        assert time.monotonic() - t0 < 30.0
+        t1 = time.monotonic()
+        loader.shutdown()
+        assert time.monotonic() - t1 < 10.0
+    finally:
+        srv.shutdown()
+
+
+def test_remote_mode_via_dist_loader_options():
+    """Worker-mode front-end reaches remote mode by option type (the
+    reference's DistLoader mode select): server_addr in the options."""
+    from glt_tpu.distributed import DistNeighborLoader
+
+    srv = init_server(build_ring_dataset())
+    loader = DistNeighborLoader(
+        [2, 2], np.arange(N), batch_size=6,
+        worker_options=RemoteSamplingWorkerOptions(
+            server_addr=srv.addr, **FAST))
+    try:
+        assert len(loader) == 4
+        seen = run_epoch(loader)
+        assert sorted(seen) == list(range(N))
+    finally:
+        loader.shutdown()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Compound weather: several fault classes across consecutive epochs
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_multi_epoch_chaos():
+    """Drops on both endpoints at different cadences, two epochs: every
+    epoch exactly-once, and the lease stays alive throughout."""
+    server_plan = FaultPlan(drop_after_frames=5)
+    client_plan = FaultPlan(drop_after_frames=4)
+    srv = init_server(build_ring_dataset(), fault_plan=server_plan,
+                      reap_interval=0.1)
+    loader = RemoteNeighborLoader(
+        srv.addr, [2, 2], np.arange(N), batch_size=2,
+        worker_options=RemoteSamplingWorkerOptions(
+            lease_secs=30.0, **FAST),
+        fault_plan=client_plan)
+    try:
+        for _ in range(2):
+            seen = run_epoch(loader)
+            assert_exactly_once(loader, seen)
+        assert srv.live_producers() == 1
+    finally:
+        loader.shutdown()
+        srv.shutdown()
