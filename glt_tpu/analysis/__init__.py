@@ -25,20 +25,37 @@ See ``docs/analysis.md`` for each rule's TPU failure mode.
 This subpackage analyzes with stdlib ``ast`` only and never imports JAX
 — the lint runs in CI images with no accelerator stack (numpy, pulled in
 by the parent package, is its only third-party import).
+
+Since PR 5 the linter is *interprocedural*: the CLI parses the whole
+file set into a :class:`~.symbols.Project` (symbol table -> call graph
+-> per-function effect summaries), GLT001/GLT002 follow calls across
+modules from any jit/shard_map entry point, and two concurrency rules
+(GLT008 lock-order-inversion, GLT009 blocking-call-while-holding-lock)
+gate the threaded distributed layer.  See ``docs/analysis.md``.
 """
-from .cli import analyze_paths, analyze_source, main
+from .cli import (
+    analyze_paths,
+    analyze_project,
+    analyze_source,
+    build_project,
+    main,
+)
 from .report import Finding, Severity, Suppressions, format_report
 from .rules import RULES, Rule, all_rules
+from .symbols import Project
 
 __all__ = [
     "Finding",
+    "Project",
     "RULES",
     "Rule",
     "Severity",
     "Suppressions",
     "all_rules",
     "analyze_paths",
+    "analyze_project",
     "analyze_source",
+    "build_project",
     "format_report",
     "main",
 ]

@@ -1,25 +1,48 @@
 """gltlint command line: ``python -m glt_tpu.analysis [paths]``.
 
-Exit codes: 0 = clean (or warnings only), 1 = at least one ERROR finding,
-2 = usage/parse problems (a file that cannot be parsed is reported as an
-error finding, not a crash — CI must not go green on a syntax error).
+The CLI parses the whole file set into one :class:`~.symbols.Project`
+(symbol table -> call graph -> effect summaries) and runs every rule per
+module with the project attached, so the interprocedural rules
+(GLT001/GLT002 transitive, GLT008/GLT009) see across files.
+
+Exit codes: 0 = clean (or warnings only), 1 = at least one gating ERROR
+finding, 2 = usage/parse problems (a file that cannot be parsed is
+reported as an error finding, not a crash — CI must not go green on a
+syntax error).
+
+Output modes (``--format``): ``text`` (default), ``json``, ``github``
+(workflow-command annotations that render inline on PRs).  A committed
+``--baseline`` file gates only on findings not already recorded
+(``--write-baseline`` records the current set); ``--profile`` prints
+per-pass timings to stderr — the CI job asserts the whole run stays
+under its time budget.
 """
 from __future__ import annotations
 
 import argparse
 import os
 import sys
-from typing import Iterable, List, Optional, Sequence
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from .report import (
     Finding,
     Severity,
     Suppressions,
     apply_suppressions,
+    format_github,
+    format_json,
     format_report,
+    load_baseline,
+    split_by_baseline,
+    write_baseline,
 )
 from .rules import RULES, Rule, all_rules
-from .visitor import ModuleInfo
+from .symbols import Project
+from .visitor import ModuleInfo, module_name_for_path
+
+_FORMATTERS = {"text": format_report, "json": format_json,
+               "github": format_github}
 
 
 def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
@@ -36,30 +59,13 @@ def iter_python_files(paths: Iterable[str]) -> Iterable[str]:
                     yield os.path.join(root, name)
 
 
-def analyze_source(source: str, path: str = "<string>",
-                   rules: Optional[Sequence[Rule]] = None,
-                   suppress: bool = True) -> List[Finding]:
-    """Run the given rules (default: all) over one module's source."""
-    rules = list(rules) if rules is not None else all_rules()
-    try:
-        module = ModuleInfo(path, source)
-    except SyntaxError as exc:
-        return [Finding(path=path, line=exc.lineno or 1,
-                        col=(exc.offset or 1), rule="parse-error",
-                        code="GLT000", severity=Severity.ERROR,
-                        message=f"cannot parse: {exc.msg}")]
+def build_project(paths: Iterable[str]
+                  ) -> Tuple[Project, List[Finding]]:
+    """Parse every file into one project; unparseable/unreadable files
+    become findings (never crashes the gate)."""
     findings: List[Finding] = []
-    for rule in rules:
-        findings.extend(rule.check(module))
-    if suppress:
-        findings = apply_suppressions(findings,
-                                      Suppressions.from_source(source))
-    return findings
-
-
-def analyze_paths(paths: Iterable[str],
-                  rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
-    findings: List[Finding] = []
+    modules: List[ModuleInfo] = []
+    seen_names: Dict[str, int] = {}
     for path in iter_python_files(paths):
         try:
             with open(path, encoding="utf-8") as fh:
@@ -69,8 +75,73 @@ def analyze_paths(paths: Iterable[str],
                 path=path, line=1, col=1, rule="io-error", code="GLT000",
                 severity=Severity.ERROR, message=str(exc)))
             continue
-        findings.extend(analyze_source(source, path, rules))
+        name = module_name_for_path(path)
+        # de-collide duplicate stems from unrelated directories
+        if name in seen_names:
+            seen_names[name] += 1
+            name = f"{name}#{seen_names[name]}"
+        else:
+            seen_names[name] = 0
+        try:
+            modules.append(ModuleInfo(path, source, module_name=name))
+        except SyntaxError as exc:
+            findings.append(Finding(
+                path=path, line=exc.lineno or 1, col=(exc.offset or 1),
+                rule="parse-error", code="GLT000",
+                severity=Severity.ERROR,
+                message=f"cannot parse: {exc.msg}"))
+    return Project(modules), findings
+
+
+def analyze_project(project: Project,
+                    rules: Optional[Sequence[Rule]] = None,
+                    suppress: bool = True) -> List[Finding]:
+    """Run the given rules (default: all) over every project module."""
+    rules = list(rules) if rules is not None else all_rules()
+    findings: List[Finding] = []
+    for path in sorted(project.by_path):
+        module = project.by_path[path]
+        module_findings: List[Finding] = []
+        for rule in rules:
+            module_findings.extend(rule.check(module, project))
+        if suppress:
+            module_findings = apply_suppressions(
+                module_findings, Suppressions.from_source(module.source))
+        findings.extend(module_findings)
     return findings
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   rules: Optional[Sequence[Rule]] = None,
+                   suppress: bool = True) -> List[Finding]:
+    """Run the given rules (default: all) over one module's source.
+
+    The module is wrapped in a single-module project, so the
+    interprocedural rules work within the file (cross-file effects need
+    :func:`analyze_paths` / :func:`analyze_project`).
+    """
+    rules = list(rules) if rules is not None else all_rules()
+    try:
+        module = ModuleInfo(path, source)
+    except SyntaxError as exc:
+        return [Finding(path=path, line=exc.lineno or 1,
+                        col=(exc.offset or 1), rule="parse-error",
+                        code="GLT000", severity=Severity.ERROR,
+                        message=f"cannot parse: {exc.msg}")]
+    project = Project([module])
+    findings: List[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(module, project))
+    if suppress:
+        findings = apply_suppressions(findings,
+                                      Suppressions.from_source(source))
+    return findings
+
+
+def analyze_paths(paths: Iterable[str],
+                  rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    project, findings = build_project(paths)
+    return findings + analyze_project(project, rules)
 
 
 def _select_rules(select: Optional[str], ignore: Optional[str]
@@ -111,6 +182,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="comma-separated rule names/codes to skip")
     parser.add_argument("--strict", action="store_true",
                         help="treat warnings as errors for the exit code")
+    parser.add_argument("--format", choices=sorted(_FORMATTERS),
+                        default="text", dest="fmt",
+                        help="report format (default: text; 'github' "
+                             "emits PR-inline workflow annotations)")
+    parser.add_argument("--baseline", metavar="FILE",
+                        help="gate only on findings not recorded in this "
+                             "baseline file")
+    parser.add_argument("--write-baseline", metavar="FILE",
+                        help="record the current findings as the "
+                             "baseline and exit 0")
+    parser.add_argument("--profile", action="store_true",
+                        help="print per-pass timings to stderr")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule registry and exit")
     args = parser.parse_args(argv)
@@ -122,8 +205,44 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
 
     rules = _select_rules(args.select, args.ignore)
-    findings = analyze_paths(args.paths, rules)
-    print(format_report(findings))
+    timings: List[Tuple[str, float]] = []
+    t0 = time.perf_counter()
+    project, findings = build_project(args.paths)
+    timings.append(("parse+symbols", time.perf_counter() - t0))
+    t0 = time.perf_counter()
+    project.effects            # force callgraph + effect summaries
+    timings.append(("callgraph+effects", time.perf_counter() - t0))
+    t0 = time.perf_counter()
+    findings = findings + analyze_project(project, rules)
+    timings.append(("rules", time.perf_counter() - t0))
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings)
+        print(f"gltlint: wrote {len(findings)} finding(s) to baseline "
+              f"{args.write_baseline}")
+        return 0
+
+    baselined = 0
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError) as exc:
+            print(f"gltlint: cannot read baseline: {exc}",
+                  file=sys.stderr)
+            return 2
+        findings, baselined = split_by_baseline(findings, baseline)
+
+    print(_FORMATTERS[args.fmt](findings))
+    if baselined and args.fmt == "text":
+        print(f"gltlint: {baselined} baselined finding(s) hidden "
+              f"({args.baseline})")
+    if args.profile:
+        total = sum(dt for _, dt in timings)
+        for name, dt in timings:
+            print(f"gltlint --profile: {name:18s} {dt * 1e3:8.1f} ms",
+                  file=sys.stderr)
+        print(f"gltlint --profile: {'total':18s} {total * 1e3:8.1f} ms",
+              file=sys.stderr)
     gate = (findings if args.strict else
             [f for f in findings if f.severity is Severity.ERROR])
     return 1 if gate else 0

@@ -19,9 +19,10 @@ from __future__ import annotations
 
 import enum
 import io
+import json
 import re
 import tokenize
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field
 from typing import Dict, Iterable, List, Set, Tuple
 
 
@@ -107,3 +108,77 @@ def format_report(findings: List[Finding]) -> str:
         lines.append("")
     lines.append(f"gltlint: {n_err} error(s), {n_warn} warning(s)")
     return "\n".join(lines)
+
+
+def format_json(findings: List[Finding]) -> str:
+    """Machine-readable report: ``{"findings": [...], "summary": ...}``."""
+    items = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        d = asdict(f)
+        d["severity"] = str(f.severity)
+        items.append(d)
+    n_err = sum(1 for f in findings if f.severity is Severity.ERROR)
+    return json.dumps({
+        "findings": items,
+        "summary": {"errors": n_err, "warnings": len(findings) - n_err},
+    }, indent=2)
+
+
+def _gh_escape(text: str, prop: bool = False) -> str:
+    """GitHub workflow-command escaping (data vs property positions)."""
+    out = text.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    if prop:
+        out = out.replace(":", "%3A").replace(",", "%2C")
+    return out
+
+
+def format_github(findings: List[Finding]) -> str:
+    """GitHub Actions workflow commands: one ``::error``/``::warning``
+    annotation per finding (renders inline on the PR diff), plus the
+    human summary line for the job log."""
+    lines = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        level = "error" if f.severity is Severity.ERROR else "warning"
+        title = _gh_escape(f"{f.code} {f.rule}", prop=True)
+        lines.append(
+            f"::{level} file={_gh_escape(f.path, prop=True)},"
+            f"line={f.line},col={f.col},title={title}"
+            f"::{_gh_escape(f.message)}")
+    n_err = sum(1 for f in findings if f.severity is Severity.ERROR)
+    lines.append(f"gltlint: {n_err} error(s), "
+                 f"{len(findings) - n_err} warning(s)")
+    return "\n".join(lines)
+
+
+# -- baseline ----------------------------------------------------------------
+#
+# A baseline lets a new (or newly-strengthened) rule land before the tree
+# is fully clean: record today's findings, gate only on findings NOT in
+# the record.  Keys deliberately exclude line/column numbers (and mask
+# digits inside messages) so unrelated edits that shift code do not
+# resurrect baselined findings.
+
+def finding_key(f: Finding) -> str:
+    return f"{f.path}|{f.code}|{re.sub(r'[0-9]+', '#', f.message)}"
+
+
+def load_baseline(path: str) -> Set[str]:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(f"{path}: not a gltlint baseline file")
+    return set(data["findings"])
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    keys = sorted({finding_key(f) for f in findings})
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "findings": keys}, fh, indent=2)
+        fh.write("\n")
+
+
+def split_by_baseline(findings: List[Finding], baseline: Set[str]
+                      ) -> Tuple[List[Finding], int]:
+    """(new findings, number suppressed by the baseline)."""
+    fresh = [f for f in findings if finding_key(f) not in baseline]
+    return fresh, len(findings) - len(fresh)

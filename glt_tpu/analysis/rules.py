@@ -1,28 +1,42 @@
-"""gltlint rules: the six TPU/JAX hazards this engine actually hits.
+"""gltlint rules: the TPU/JAX + concurrency hazards this engine hits.
 
-Each rule is a class with a ``check(module: ModuleInfo) -> [Finding]``
+Each rule is a class with a ``check(module, project=None) -> [Finding]``
 method, registered in ``RULES`` by name.  Severities: ERROR findings gate
-CI (non-zero exit), WARNINGs report but pass.
+CI (non-zero exit), WARNINGs report but pass.  ``project`` — the
+project-wide symbol table / call graph / effect summaries
+(analysis/symbols.py) — is provided whenever the CLI analyzes a file
+set; rules use it to follow effects through calls (GLT001/GLT002 become
+transitive, GLT008/GLT009 — analysis/concurrency.py — are built on it).
+Without a project a rule degrades to its intraprocedural behavior.
 
-The analyses are deliberately linear/flow-light: statements are walked in
-source order, ``if`` branches fork analysis state, loops are traversed
-once.  That trades soundness for a near-zero false-positive rate on this
-codebase — every rule here was calibrated by running it over ``glt_tpu``
-and inspecting each hit.
+The intraprocedural analyses are deliberately linear/flow-light:
+statements are walked in source order, ``if`` branches fork analysis
+state, loops are traversed once.  That trades soundness for a near-zero
+false-positive rate on this codebase — every rule here was calibrated by
+running it over ``glt_tpu`` and inspecting each hit.  The
+interprocedural layer keeps that bias: unresolvable calls contribute no
+effects rather than worst-case guesses.
 """
 from __future__ import annotations
 
 import ast
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
+from .effects import COERCIONS, HOST_SYNC_CALLS, SYNC_METHODS
+from .effects import KEY_SOURCES as _KEY_SOURCES_IMPORTED
+from .effects import NON_CONSUMING as _NON_CONSUMING_IMPORTED
 from .report import Finding, Severity
+from .symbols import FunctionSymbol
 from .visitor import (
     JIT_NAMES,
     FunctionScope,
     ModuleInfo,
     assign_targets,
+    dotted_expr,
     names_loaded,
     param_names,
+    traced_names,
+    walk_own,
 )
 
 RULES: Dict[str, type] = {}
@@ -47,34 +61,15 @@ class Rule:
                        code=self.code, severity=self.severity,
                        message=message)
 
-    def check(self, module: ModuleInfo) -> List[Finding]:
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
         raise NotImplementedError
 
 
-def _walk_own(node: ast.AST) -> Iterator[ast.AST]:
-    """Walk an AST without descending into nested function/class bodies
-    (those are separate scopes with their own analysis passes)."""
-    stack = list(ast.iter_child_nodes(node))
-    while stack:
-        cur = stack.pop()
-        yield cur
-        if not isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                ast.Lambda, ast.ClassDef)):
-            stack.extend(ast.iter_child_nodes(cur))
-
-
-def _dotted(node: ast.expr) -> Optional[str]:
-    """'self.x.y' style dotted string for Name/Attribute chains (no alias
-    resolution — used for tracking local/attribute variables)."""
-    parts: List[str] = []
-    cur = node
-    while isinstance(cur, ast.Attribute):
-        parts.append(cur.attr)
-        cur = cur.value
-    if not isinstance(cur, ast.Name):
-        return None
-    parts.append(cur.id)
-    return ".".join(reversed(parts))
+# Shared AST helpers live in visitor.py; local aliases keep this module's
+# rule bodies terse.
+_walk_own = walk_own
+_dotted = dotted_expr
+_traced_names = traced_names
 
 
 def _expr_names(node: ast.AST) -> Set[str]:
@@ -88,32 +83,70 @@ def _expr_names(node: ast.AST) -> Set[str]:
     return out
 
 
-_STATIC_ATTRS = ("shape", "ndim", "dtype", "size")
-
-
-def _traced_names(node: ast.AST) -> Set[str]:
-    """Like :func:`_expr_names`, but a name reached only through a static
-    attribute (``x.shape[0]`` — a Python int even on a tracer) does not
-    count as a traced-value read."""
-    out: Set[str] = set()
-    stack = [node]
-    while stack:
-        cur = stack.pop()
-        if isinstance(cur, ast.Attribute) and cur.attr in _STATIC_ATTRS:
-            continue                       # x.shape / x.ndim: static
-        if isinstance(cur, ast.Name) and isinstance(cur.ctx, ast.Load):
-            out.add(cur.id)
-        if isinstance(cur, ast.Attribute):
-            d = _dotted(cur)
-            if d is not None:
-                out.add(d)
-        stack.extend(ast.iter_child_nodes(cur))
-    return out
-
-
 # ---------------------------------------------------------------------------
 # GLT001 host-sync-in-jit
 # ---------------------------------------------------------------------------
+
+def compute_jit_taint(module: ModuleInfo
+                      ) -> Dict[FunctionScope, Set[str]]:
+    """Traced-value sets for every jit-context scope in the module.
+
+    Fixpoint so transitively-jitted helpers see their caller's taint
+    (their params are traced only if the call site passes traced values —
+    static sizing helpers called with Python config stay clean).
+    """
+    taint_by_scope: Dict[FunctionScope, Set[str]] = {}
+    for _ in range(4):
+        changed = False
+        for scope in module.scopes:   # DFS order: parents first
+            if not module.in_jit_context(scope):
+                continue
+            taint = _seed_taint(module, scope, taint_by_scope)
+            if scope.parent in taint_by_scope:
+                taint |= taint_by_scope[scope.parent]
+            # two linear passes propagate taint through assignments
+            for _ in range(2):
+                for node in _walk_own(scope.node):
+                    if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                         ast.AugAssign)):
+                        value = node.value
+                        if value is not None and (_traced_names(value)
+                                                  & taint):
+                            taint |= set(assign_targets(node))
+            if taint_by_scope.get(scope) != taint:
+                taint_by_scope[scope] = taint
+                changed = True
+        if not changed:
+            break
+    return taint_by_scope
+
+
+def _seed_taint(module: ModuleInfo, scope: FunctionScope,
+                taint_by_scope: Dict[FunctionScope, Set[str]]
+                ) -> Set[str]:
+    """Initial traced-value set: all params for direct jit roots, only
+    traced-at-the-call-site params for transitive ones."""
+    if scope.transitive_call is None:
+        # `self`/`cls` are bound (or closure-captured) at jit time,
+        # never traced — counting them floods attribute reads.
+        return set(scope.params) - scope.static_args - {"self", "cls"}
+    caller, call = scope.transitive_call
+    caller_taint = taint_by_scope.get(caller, set())
+    params = scope.params
+    # bound method call (self.f(...)): positional args bind past self
+    if params[:1] == ["self"] and isinstance(call.func, ast.Attribute):
+        pos = params[1:]
+    else:
+        pos = params
+    seed: Set[str] = set()
+    for i, arg in enumerate(call.args):
+        if i < len(pos) and (_traced_names(arg) & caller_taint):
+            seed.add(pos[i])
+    for kw in call.keywords:
+        if kw.arg in params and (_traced_names(kw.value) & caller_taint):
+            seed.add(kw.arg)
+    return seed - scope.static_args
+
 
 @register
 class HostSyncInJit(Rule):
@@ -124,49 +157,27 @@ class HostSyncInJit(Rule):
     (TracerArrayConversionError) or — worse, via callbacks — inserts a
     device->host sync into the sampling hot path, serialising the TPU
     against the host exactly as BGL measured for GNN data pipelines.
+
+    With a project, the check is transitive across modules: a call from a
+    jit context that passes a traced value into another module's function
+    whose effect summary says that parameter reaches a host sync
+    (directly or through further calls) is flagged at the call site, with
+    the chain in the message.
     """
     name = "host-sync-in-jit"
     code = "GLT001"
     severity = Severity.ERROR
     description = ("numpy conversion / Python scalar coercion of a traced "
-                   "value inside a jit/shard_map context")
+                   "value inside a jit/shard_map context (transitive "
+                   "through project calls)")
 
-    HOST_CALLS = {
-        "numpy.asarray", "numpy.array", "numpy.copy", "numpy.frombuffer",
-        "numpy.ascontiguousarray", "jax.device_get",
-    }
-    COERCIONS = {"int", "float", "bool", "complex"}
-    SYNC_METHODS = {"item", "tolist", "to_py", "block_until_ready"}
+    HOST_CALLS = HOST_SYNC_CALLS
+    COERCIONS = COERCIONS
+    SYNC_METHODS = SYNC_METHODS
 
-    def check(self, module: ModuleInfo) -> List[Finding]:
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
         findings: List[Finding] = []
-        taint_by_scope: Dict[FunctionScope, Set[str]] = {}
-        # Fixpoint so transitively-jitted helpers see their caller's taint
-        # (their params are traced only if the call site passes traced
-        # values — static sizing helpers called with Python config stay
-        # clean).
-        for _ in range(4):
-            changed = False
-            for scope in module.scopes:   # DFS order: parents first
-                if not module.in_jit_context(scope):
-                    continue
-                taint = self._seed_taint(module, scope, taint_by_scope)
-                if scope.parent in taint_by_scope:
-                    taint |= taint_by_scope[scope.parent]
-                # two linear passes propagate taint through assignments
-                for _ in range(2):
-                    for node in _walk_own(scope.node):
-                        if isinstance(node, (ast.Assign, ast.AnnAssign,
-                                             ast.AugAssign)):
-                            value = node.value
-                            if value is not None and (_traced_names(value)
-                                                      & taint):
-                                taint |= set(assign_targets(node))
-                if taint_by_scope.get(scope) != taint:
-                    taint_by_scope[scope] = taint
-                    changed = True
-            if not changed:
-                break
+        taint_by_scope = compute_jit_taint(module)
         for scope in module.scopes:
             if not module.in_jit_context(scope):
                 continue
@@ -175,33 +186,49 @@ class HostSyncInJit(Rule):
                 if not isinstance(node, ast.Call):
                     continue
                 findings.extend(self._check_call(module, scope, node, taint))
+                if project is not None and taint:
+                    findings.extend(self._check_cross_module(
+                        module, scope, node, taint, project))
         return findings
 
-    def _seed_taint(self, module: ModuleInfo, scope: FunctionScope,
-                    taint_by_scope: Dict[FunctionScope, Set[str]]
-                    ) -> Set[str]:
-        """Initial traced-value set: all params for direct jit roots, only
-        traced-at-the-call-site params for transitive ones."""
-        if scope.transitive_call is None:
-            # `self`/`cls` are bound (or closure-captured) at jit time,
-            # never traced — counting them floods attribute reads.
-            return set(scope.params) - scope.static_args - {"self", "cls"}
-        caller, call = scope.transitive_call
-        caller_taint = taint_by_scope.get(caller, set())
-        params = scope.params
-        # bound method call (self.f(...)): positional args bind past self
+    def _check_cross_module(self, module: ModuleInfo, scope: FunctionScope,
+                            call: ast.Call, taint: Set[str],
+                            project) -> List[Finding]:
+        """Follow the call into another module's effect summary."""
+        sym = project.resolve_call(module, scope, call)
+        if not isinstance(sym, FunctionSymbol) or sym.module is module:
+            return []          # same-module helpers: the pass above
+        if sym.module.in_jit_context(sym.scope):
+            return []          # callee's own module pass reports inside
+        summary = project.effects.summary_for(sym)
+        sync = summary.sync_param_map()
+        if not sync:
+            return []
+        params = sym.scope.params
         if params[:1] == ["self"] and isinstance(call.func, ast.Attribute):
             pos = params[1:]
         else:
             pos = params
-        seed: Set[str] = set()
+        hits = []
         for i, arg in enumerate(call.args):
-            if i < len(pos) and (_traced_names(arg) & caller_taint):
-                seed.add(pos[i])
+            if i < len(pos) and pos[i] in sync \
+                    and (_traced_names(arg) & taint):
+                hits.append((pos[i], arg))
         for kw in call.keywords:
-            if kw.arg in params and (_traced_names(kw.value) & caller_taint):
-                seed.add(kw.arg)
-        return seed - scope.static_args
+            if kw.arg in sync and (_traced_names(kw.value) & taint):
+                hits.append((kw.arg, kw.value))
+        out = []
+        for p, arg in hits[:1]:     # one finding per call site
+            site = sync[p]
+            var = sorted(_traced_names(arg) & taint)[0]
+            out.append(self.finding(
+                module, call,
+                f"traced value '{var}' flows into '{sym.short}' whose "
+                f"parameter '{p}' reaches {site.detail} "
+                f"({sym.module.path}:{site.line}) — host sync inside jit "
+                f"context '{scope.name}'; keep the helper jnp-pure or "
+                f"hoist the call to host code"))
+        return out
 
     def _check_call(self, module: ModuleInfo, scope: FunctionScope,
                     call: ast.Call, taint: Set[str]) -> List[Finding]:
@@ -239,13 +266,9 @@ class HostSyncInJit(Rule):
 # GLT002 prng-key-reuse
 # ---------------------------------------------------------------------------
 
-_KEY_SOURCES = {
-    "jax.random.PRNGKey", "jax.random.key", "jax.random.split",
-    "jax.random.fold_in", "jax.random.clone", "jax.random.wrap_key_data",
-}
+_KEY_SOURCES = _KEY_SOURCES_IMPORTED
 # Deriving fresh keys from a base key is the sanctioned way to reuse it.
-_NON_CONSUMING = {"jax.random.split", "jax.random.fold_in",
-                  "jax.random.clone", "jax.random.key_data"}
+_NON_CONSUMING = _NON_CONSUMING_IMPORTED
 _KEY_PARAM_HINTS = ("key", "rng", "prng")
 
 
@@ -264,18 +287,29 @@ class PrngKeyReuse(Rule):
     *identical* randomness — on the sampler hot path that silently
     correlates hops/batches (every neighbor draw repeats).  A key may be
     consumed once; reuse requires an intervening ``split``/``fold_in``.
+
+    With a project, call sites resolving to project functions consult the
+    callee's effect summary: only arguments bound to parameters the
+    callee actually consumes as keys (directly or transitively) count as
+    consumption — a helper that merely ``split``s its key argument is as
+    safe as ``jax.random.split`` itself, and a consuming helper two
+    modules away still burns the key.  Unresolvable calls keep the
+    conservative behavior (any call consumes).
     """
     name = "prng-key-reuse"
     code = "GLT002"
     severity = Severity.ERROR
-    description = ("a PRNG key passed to two consuming calls without an "
+    description = ("a PRNG key passed to two consuming calls (callee "
+                   "effect summaries decide consumption) without an "
                    "intervening jax.random.split/fold_in")
 
-    def check(self, module: ModuleInfo) -> List[Finding]:
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
         findings: List[Finding] = []
+        self._project = project
         for scope in module.scopes:
             if isinstance(scope.node, ast.Lambda):
                 continue
+            self._scope = scope
             state: Dict[str, int] = {
                 p: 0 for p in scope.params if _looks_like_key_param(p)}
             self._run(module, scope.node.body, state, findings)
@@ -320,6 +354,31 @@ class PrngKeyReuse(Rule):
             if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
                 self._apply_assign(module, stmt, state)
 
+    def _consuming_arg_ids(self, module: ModuleInfo,
+                           node: ast.Call) -> Optional[Set[int]]:
+        """With a resolved callee summary: the ``id()``s of the argument
+        nodes bound to key-consuming parameters.  None means the call is
+        unresolvable — treat every argument as consuming (conservative)."""
+        if self._project is None:
+            return None
+        sym = self._project.resolve_call(module, self._scope, node)
+        if not isinstance(sym, FunctionSymbol):
+            return None
+        summary = self._project.effects.summary_for(sym)
+        params = sym.scope.params
+        if params[:1] == ["self"] and isinstance(node.func, ast.Attribute):
+            pos = params[1:]
+        else:
+            pos = params
+        consuming: Set[int] = set()
+        for i, arg in enumerate(node.args):
+            if i < len(pos) and pos[i] in summary.key_params:
+                consuming.add(id(arg))
+        for kw in node.keywords:
+            if kw.arg in summary.key_params:
+                consuming.add(id(kw.value))
+        return consuming
+
     def _visit_exprs(self, module: ModuleInfo, stmt: ast.stmt,
                      state: Dict[str, int], findings: List[Finding],
                      skip_body: bool = False) -> None:
@@ -342,8 +401,11 @@ class PrngKeyReuse(Rule):
             name = module.call_name(node)
             if name in _NON_CONSUMING:
                 continue
+            consuming = self._consuming_arg_ids(module, node)
             for arg in list(node.args) + [kw.value for kw in node.keywords]:
                 if isinstance(arg, ast.Name) and arg.id in state:
+                    if consuming is not None and id(arg) not in consuming:
+                        continue     # callee provably derives, not draws
                     state[arg.id] += 1
                     if state[arg.id] == 2:
                         findings.append(self.finding(
@@ -388,7 +450,8 @@ class RecompileHazard(Rule):
 
     _SCALAR_CALLS = {"int", "float", "len", "round", "min", "max"}
 
-    def check(self, module: ModuleInfo) -> List[Finding]:
+    def check(self, module: ModuleInfo, project=None
+              ) -> List[Finding]:
         findings: List[Finding] = []
         for scope in module.scopes:
             if isinstance(scope.node, ast.Lambda):
@@ -493,7 +556,8 @@ class Int64IdTruncation(Rule):
 
     _SINKS = {"jax.numpy.asarray", "jax.numpy.array"}
 
-    def check(self, module: ModuleInfo) -> List[Finding]:
+    def check(self, module: ModuleInfo, project=None
+              ) -> List[Finding]:
         findings: List[Finding] = []
         module_taint = self._collect_taint(module, module.tree, set())
         self._scan(module, module.tree, module_taint, findings,
@@ -650,7 +714,8 @@ class NondeterministicDefaultRng(Rule):
     _RNG = {"numpy.random.default_rng", "numpy.random.Generator",
             "numpy.random.RandomState"}
 
-    def check(self, module: ModuleInfo) -> List[Finding]:
+    def check(self, module: ModuleInfo, project=None
+              ) -> List[Finding]:
         findings: List[Finding] = []
         # fresh-generator-inline-draw: default_rng(seed).permutation(x)
         # where `seed` is a parameter of the enclosing function replays
@@ -719,7 +784,8 @@ class ShadowedJitDonation(Rule):
     description = ("an array used again after being passed through "
                    "donate_argnums")
 
-    def check(self, module: ModuleInfo) -> List[Finding]:
+    def check(self, module: ModuleInfo, project=None
+              ) -> List[Finding]:
         donors = self._collect_donors(module)
         if not donors:
             return []
@@ -857,7 +923,8 @@ class UnboundedBlockingGet(Rule):
     # pattern; its waits are bounded by the recheck loop.
     _LIVENESS = {"is_alive", "is_set", "poll"}
 
-    def check(self, module: ModuleInfo) -> List[Finding]:
+    def check(self, module: ModuleInfo, project=None
+              ) -> List[Finding]:
         findings: List[Finding] = []
         regions = [module.tree] + [
             s.node for s in module.scopes
@@ -891,3 +958,9 @@ def _iter_const_ints(node: ast.expr) -> Iterator[int]:
 
 def all_rules() -> List[Rule]:
     return [cls() for cls in RULES.values()]
+
+
+# The concurrency rules (GLT008/GLT009) live in their own module but
+# register into the same RULES table; importing here completes the
+# registry for every entry point (cli, tests, programmatic use).
+from . import concurrency  # noqa: E402,F401  (registration side effect)

@@ -25,6 +25,7 @@ top with :func:`iter_statements` / :func:`names_loaded` helpers.
 from __future__ import annotations
 
 import ast
+import os
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple, Union
 
@@ -46,24 +47,45 @@ TRACE_WRAPPERS = JIT_NAMES | SHARD_MAP_NAMES | {
 
 
 class ImportMap:
-    """Alias table mapping local names to canonical dotted module paths."""
+    """Alias table mapping local names to canonical dotted module paths.
+
+    When ``package`` is given (the importing module's package), relative
+    imports — ``from ..channel.base import bounded_get`` — are resolved
+    against it to absolute dotted paths, so cross-module symbol lookup
+    (analysis/symbols.py) sees one canonical spelling.
+    """
 
     def __init__(self) -> None:
         self._alias: Dict[str, str] = {}
 
-    def collect(self, tree: ast.AST) -> "ImportMap":
+    def collect(self, tree: ast.AST, package: str = "") -> "ImportMap":
         for node in ast.walk(tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
                     self._alias[a.asname or a.name.split(".")[0]] = (
                         a.name if a.asname else a.name.split(".")[0])
-            elif isinstance(node, ast.ImportFrom) and node.module:
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    pkg = package.split(".") if package else []
+                    cut = len(pkg) - (node.level - 1)
+                    if cut < 0:
+                        continue          # escapes the analyzed root
+                    prefix = ".".join(pkg[:cut])
+                    base = (f"{prefix}.{node.module}"
+                            if node.module and prefix
+                            else (prefix or node.module or ""))
+                if not base:
+                    continue
                 for a in node.names:
                     if a.name == "*":
                         continue
-                    self._alias[a.asname or a.name] = (
-                        f"{node.module}.{a.name}")
+                    self._alias[a.asname or a.name] = f"{base}.{a.name}"
         return self
+
+    def alias_of(self, name: str) -> Optional[str]:
+        """The canonical dotted target this local name was imported as."""
+        return self._alias.get(name)
 
     def resolve(self, node: ast.expr) -> Optional[str]:
         """Canonical dotted path for a Name/Attribute chain, else None.
@@ -118,6 +140,72 @@ def assign_targets(node: ast.stmt) -> List[str]:
                         el.value, ast.Name):
                     out.append(el.value.id)
     return out
+
+
+def walk_own(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk an AST without descending into nested function/class bodies
+    (those are separate scopes with their own analysis passes)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if not isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(cur))
+
+
+def dotted_expr(node: ast.expr) -> Optional[str]:
+    """'self.x.y' style dotted string for Name/Attribute chains (no alias
+    resolution — used for tracking local/attribute variables)."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if not isinstance(cur, ast.Name):
+        return None
+    parts.append(cur.id)
+    return ".".join(reversed(parts))
+
+
+STATIC_ATTRS = ("shape", "ndim", "dtype", "size")
+
+
+def traced_names(node: ast.AST) -> Set[str]:
+    """Names + dotted attribute strings read inside ``node``, except those
+    reached only through a static attribute (``x.shape[0]`` is a Python
+    int even on a tracer, so it is not a traced-value read)."""
+    out: Set[str] = set()
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, ast.Attribute) and cur.attr in STATIC_ATTRS:
+            continue                       # x.shape / x.ndim: static
+        if isinstance(cur, ast.Name) and isinstance(cur.ctx, ast.Load):
+            out.add(cur.id)
+        if isinstance(cur, ast.Attribute):
+            d = dotted_expr(cur)
+            if d is not None:
+                out.add(d)
+        stack.extend(ast.iter_child_nodes(cur))
+    return out
+
+
+def module_name_for_path(path: str) -> str:
+    """Dotted module name for a file, walking up through ``__init__.py``
+    packages (``glt_tpu/channel/base.py`` -> ``glt_tpu.channel.base``); a
+    file outside any package resolves to its bare stem."""
+    path = os.path.abspath(path)
+    base = os.path.splitext(os.path.basename(path))[0]
+    parts = [] if base == "__init__" else [base]
+    d = os.path.dirname(path)
+    while d and os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.insert(0, os.path.basename(d))
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return ".".join(parts) or base
 
 
 def iter_statements(body: Sequence[ast.stmt]) -> Iterator[ast.stmt]:
@@ -208,13 +296,28 @@ def _iter_const(node: ast.expr) -> Iterator[object]:
 
 
 class ModuleInfo:
-    """Parsed module + resolved imports + jit-context classification."""
+    """Parsed module + resolved imports + jit-context classification.
 
-    def __init__(self, path: str, source: str):
+    ``module_name`` (the dotted import path, e.g.
+    ``glt_tpu.distributed.dist_server``) keys the module in a project-wide
+    analysis (analysis/symbols.py) and anchors relative-import resolution;
+    when omitted it defaults to the file stem and relative imports stay
+    unresolved (single-module analysis, fixtures).
+    """
+
+    def __init__(self, path: str, source: str,
+                 module_name: Optional[str] = None):
         self.path = path
         self.source = source
+        self.name = module_name or os.path.splitext(
+            os.path.basename(path))[0]
+        if os.path.basename(path) == "__init__.py":
+            self.package = self.name
+        else:
+            self.package = (self.name.rsplit(".", 1)[0]
+                            if "." in self.name else "")
         self.tree = ast.parse(source, filename=path)
-        self.imports = ImportMap().collect(self.tree)
+        self.imports = ImportMap().collect(self.tree, package=self.package)
         self.parents: Dict[ast.AST, ast.AST] = {}
         for parent in ast.walk(self.tree):
             for child in ast.iter_child_nodes(parent):

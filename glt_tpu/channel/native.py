@@ -29,18 +29,37 @@ def ensure_built() -> str:
     if (not os.path.exists(_SO)
             or os.path.getmtime(_SO) < os.path.getmtime(src)):
         os.makedirs(os.path.dirname(_SO), exist_ok=True)
-        subprocess.run(
-            ["g++", "-O2", "-fPIC", "-shared", "-pthread", "-std=c++17",
-             src, "-o", _SO, "-lrt"],
-            check=True, capture_output=True)
+        # Build to a private temp path and publish with an atomic rename:
+        # concurrent builders (threads that both saw a stale .so, or two
+        # processes sharing the checkout) each publish a complete library
+        # instead of interleaving writes into one corrupt file — which is
+        # also what lets lib() run this seconds-long g++ wait OUTSIDE its
+        # lock (gltlint GLT009).
+        tmp = f"{_SO}.{os.getpid()}.tmp"
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-fPIC", "-shared", "-pthread",
+                 "-std=c++17", src, "-o", tmp, "-lrt"],
+                check=True, capture_output=True)
+            os.replace(tmp, _SO)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
     return _SO
 
 
 def lib() -> ctypes.CDLL:
     global _LIB
+    if _LIB is not None:      # fast path: no lock once loaded (GIL-safe)
+        return _LIB
+    # The blocking part (a possible g++ build) runs before the lock is
+    # taken; ensure_built() is safe to race because it publishes
+    # atomically.  The lock only serializes the cheap CDLL load +
+    # prototype setup so _LIB is initialized exactly once.
+    so_path = ensure_built()
     with _LOCK:
         if _LIB is None:
-            L = ctypes.CDLL(ensure_built())
+            L = ctypes.CDLL(so_path)
             L.glt_shmq_create.restype = ctypes.c_void_p
             L.glt_shmq_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
             L.glt_shmq_attach.restype = ctypes.c_void_p

@@ -147,6 +147,17 @@ class RemoteServerConnection:
                     raise ConnectionAbortedError(
                         "exchange stopped by shutdown")
                 if attempt:
+                    # Justified (gltlint GLT009): the whole retry loop —
+                    # backoff sleep, reconnect, send, recv — deliberately
+                    # runs under the per-connection lock.  The framed
+                    # protocol is a strict request-response stream: a
+                    # second thread interleaving mid-round-trip would
+                    # desync the framing for both.  The bounded escape
+                    # hatch is interrupt(): it closes the socket out of
+                    # band, the blocked I/O raises, and the stop-aware
+                    # loop observes `stop` and releases the lock (used by
+                    # RemoteNeighborLoader.__iter__'s finally).
+                    # gltlint: disable-next=blocking-call-while-holding-lock
                     self._sleep_backoff(attempt - 1, stop)
                     if stop is not None and stop.is_set():
                         raise ConnectionAbortedError(

@@ -3,7 +3,7 @@
 # shell-loops `python test_*.py`; here the suite is pytest-native).
 set -euo pipefail
 cd "$(dirname "$0")/.."
-# gltlint first: the same static-analysis gate CI runs (fails fast, no
-# jax import needed) — see docs/analysis.md.
-python -m glt_tpu.analysis glt_tpu
+# gltlint first: the same interprocedural static-analysis gate CI runs
+# (fails fast, no jax import needed) — see docs/analysis.md.
+python -m glt_tpu.analysis glt_tpu --baseline .gltlint-baseline.json
 exec python -m pytest tests/ -q "$@"

@@ -13,13 +13,33 @@ import textwrap
 import pytest
 
 from glt_tpu.analysis import Severity, analyze_source
+from glt_tpu.analysis.cli import analyze_project
 from glt_tpu.analysis.rules import RULES
+from glt_tpu.analysis.symbols import Project
+from glt_tpu.analysis.visitor import ModuleInfo
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def findings_for(src, rule=None):
     out = analyze_source(textwrap.dedent(src), "fixture.py")
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    return out
+
+
+def make_project(sources):
+    """A Project from ``{dotted_module_name: source}`` (no filesystem)."""
+    mods = [
+        ModuleInfo(name.replace(".", "/") + ".py", textwrap.dedent(src),
+                   module_name=name)
+        for name, src in sources.items()
+    ]
+    return Project(mods)
+
+
+def project_findings(sources, rule=None):
+    out = analyze_project(make_project(sources))
     if rule is not None:
         out = [f for f in out if f.rule == rule]
     return out
@@ -498,6 +518,561 @@ class TestUnboundedBlockingGet:
 
 
 # ---------------------------------------------------------------------------
+# the project engine: symbols, call graph, effects
+# ---------------------------------------------------------------------------
+
+class TestSymbolsAndCallGraph:
+    def test_import_aliasing_cross_module(self):
+        # `from x import y as z` must land on the one definition
+        sources = {
+            "pkg.helpers": """
+                import numpy as np
+
+                def to_host(v):
+                    return np.asarray(v)
+            """,
+            "pkg.main": """
+                import jax
+                from pkg.helpers import to_host as th
+
+                @jax.jit
+                def f(x):
+                    return th(x * 2)
+            """,
+        }
+        hits = project_findings(sources, "host-sync-in-jit")
+        assert len(hits) == 1
+        assert hits[0].path == "pkg/main.py"
+        assert "np" not in hits[0].rule
+
+    def test_reexport_through_package_init(self):
+        mods = [
+            ModuleInfo("pkg/__init__.py",
+                       "from .helpers import to_host\n",
+                       module_name="pkg"),
+            ModuleInfo("pkg/helpers.py", textwrap.dedent("""
+                import numpy as np
+
+                def to_host(v):
+                    return np.asarray(v)
+            """), module_name="pkg.helpers"),
+            ModuleInfo("pkg/main.py", textwrap.dedent("""
+                import jax
+                from pkg import to_host
+
+                @jax.jit
+                def f(x):
+                    return to_host(x)
+            """), module_name="pkg.main"),
+        ]
+        project = Project(mods)
+        hits = [f for f in analyze_project(project)
+                if f.rule == "host-sync-in-jit"]
+        assert len(hits) == 1 and hits[0].path == "pkg/main.py"
+
+    def test_relative_import_resolution(self):
+        mods = [
+            ModuleInfo("pkg/helpers.py", textwrap.dedent("""
+                import numpy as np
+
+                def to_host(v):
+                    return np.asarray(v)
+            """), module_name="pkg.helpers"),
+            ModuleInfo("pkg/main.py", textwrap.dedent("""
+                import jax
+                from .helpers import to_host
+
+                @jax.jit
+                def f(x):
+                    return to_host(x)
+            """), module_name="pkg.main"),
+        ]
+        hits = [f for f in analyze_project(Project(mods))
+                if f.rule == "host-sync-in-jit"]
+        assert len(hits) == 1
+
+    def test_callgraph_cycle_terminates_and_propagates(self):
+        # mutual recursion: effect computation must neither hang nor miss
+        # the blocking effect inside the cycle
+        project = make_project({"pkg.cyc": """
+            import time
+
+            def a(n):
+                if n > 0:
+                    b(n - 1)
+                time.sleep(0.1)
+
+            def b(n):
+                a(n)
+        """})
+        eng = project.effects
+        for fid in ("pkg.cyc.a", "pkg.cyc.b"):
+            assert eng.summaries[fid].blocking, fid
+
+    def test_callgraph_bounded_depth_cutoff(self):
+        chain = "\n\n".join(
+            [f"def f{i}(x):\n    return f{i + 1}(x)" for i in range(5)]
+            + ["def f5(x):\n    return x"])
+        project = make_project({"pkg.chain": chain})
+        graph = project.effects.graph
+        depths = graph.reachable("pkg.chain.f0", max_depth=2)
+        assert depths == {"pkg.chain.f0": 0, "pkg.chain.f1": 1,
+                          "pkg.chain.f2": 2}
+        assert len(graph.reachable("pkg.chain.f0")) == 6
+
+    def test_effect_chain_depth_cutoff(self):
+        # a blocking effect buried deeper than MAX_CHAIN_DEPTH calls is
+        # cut off rather than propagated forever
+        from glt_tpu.analysis.effects import MAX_CHAIN_DEPTH
+        n = MAX_CHAIN_DEPTH + 3
+        parts = ["import time", "def g0():\n    time.sleep(1)"]
+        for i in range(1, n):
+            parts.append(f"def g{i}():\n    g{i - 1}()")
+        project = make_project({"pkg.deep": "\n\n".join(parts)})
+        eng = project.effects
+        assert eng.summaries["pkg.deep.g0"].blocking
+        assert eng.summaries[f"pkg.deep.g{MAX_CHAIN_DEPTH - 1}"].blocking
+        assert not eng.summaries[f"pkg.deep.g{n - 1}"].blocking
+
+    def test_method_resolution_via_constructor_type(self):
+        project = make_project({"pkg.svc": """
+            import socket
+            import threading
+
+            class Conn:
+                def __init__(self):
+                    self.sock = socket.socket()
+
+                def roundtrip(self):
+                    return self.sock.recv(64)
+
+            class Owner:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.conn = Conn()
+
+                def locked_io(self):
+                    with self._lock:
+                        return self.conn.roundtrip()
+        """})
+        hits = [f for f in analyze_project(project)
+                if f.rule == "blocking-call-while-holding-lock"]
+        assert len(hits) == 1
+        assert "roundtrip" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# GLT001/GLT002 transitive (cross-module) upgrades
+# ---------------------------------------------------------------------------
+
+class TestHostSyncTransitive:
+    HELPERS = """
+        import numpy as np
+
+        def to_host(v):
+            return np.asarray(v)
+
+        def cap(width, load):
+            return int(round(load * width))
+    """
+
+    def test_positive_traced_arg_into_cross_module_sync(self):
+        hits = project_findings({
+            "pkg.helpers": self.HELPERS,
+            "pkg.main": """
+                import jax
+                from pkg.helpers import to_host
+
+                @jax.jit
+                def f(x):
+                    return to_host(x * 2)
+            """,
+        }, "host-sync-in-jit")
+        assert len(hits) == 1
+        assert hits[0].path == "pkg/main.py"
+        assert "to_host" in hits[0].message
+        assert "helpers.py" in hits[0].message   # the chain names the sink
+
+    def test_negative_static_config_args_stay_clean(self):
+        hits = project_findings({
+            "pkg.helpers": self.HELPERS,
+            "pkg.main": """
+                import jax
+                from pkg.helpers import cap, to_host
+
+                def host_stage(ids):
+                    return to_host(ids)        # not a jit context
+
+                @jax.jit
+                def f(x):
+                    c = cap(4, 2.0)            # Python config only
+                    return x[:c]
+            """,
+        }, "host-sync-in-jit")
+        assert hits == []
+
+    def test_positive_two_level_chain(self):
+        # jit -> mid (other module) -> sink (third module)
+        hits = project_findings({
+            "pkg.sink": """
+                import numpy as np
+
+                def materialize(arr):
+                    return np.asarray(arr)
+            """,
+            "pkg.mid": """
+                from pkg.sink import materialize
+
+                def relay(v):
+                    return materialize(v)
+            """,
+            "pkg.main": """
+                import jax
+                from pkg.mid import relay
+
+                @jax.jit
+                def f(x):
+                    return relay(x)
+            """,
+        }, "host-sync-in-jit")
+        assert len(hits) == 1 and hits[0].path == "pkg/main.py"
+
+    def test_cross_module_jit_wrap_marks_entry_point(self):
+        # jax.jit(imported_fn): the wrap is in main, the body (and the
+        # finding) in the helper module
+        hits = project_findings({
+            "pkg.step": """
+                import numpy as np
+
+                def step(x):
+                    return np.asarray(x) + 1
+            """,
+            "pkg.main": """
+                import jax
+                from pkg.step import step
+
+                train = jax.jit(step)
+            """,
+        }, "host-sync-in-jit")
+        assert len(hits) == 1 and hits[0].path == "pkg/step.py"
+
+
+class TestPrngKeyReuseTransitive:
+    KEYS = """
+        import jax
+
+        def draw(k, shape):
+            return jax.random.uniform(k, shape)
+
+        def derive(k, n):
+            return jax.random.fold_in(k, n)
+    """
+
+    def test_positive_cross_module_consuming_helper(self):
+        hits = project_findings({
+            "pkg.keys": self.KEYS,
+            "pkg.main": """
+                from pkg.keys import draw
+
+                def sample(key):
+                    a = draw(key, (4,))
+                    b = draw(key, (4,))
+                    return a + b
+            """,
+        }, "prng-key-reuse")
+        assert len(hits) == 1
+        assert "'key'" in hits[0].message
+
+    def test_negative_resolved_deriving_helper_not_consuming(self):
+        # the precision upgrade: a helper that only fold_ins its key is
+        # as safe as jax.random.fold_in itself (the flow-light rule used
+        # to count any call as consumption)
+        hits = project_findings({
+            "pkg.keys": self.KEYS,
+            "pkg.main": """
+                import jax
+                from pkg.keys import derive
+
+                def sample(key):
+                    a = jax.random.uniform(derive(key, 1), (4,))
+                    b = jax.random.uniform(derive(key, 2), (4,))
+                    return a + b
+            """,
+        }, "prng-key-reuse")
+        assert hits == []
+
+    def test_positive_two_level_consumption(self):
+        hits = project_findings({
+            "pkg.keys": self.KEYS,
+            "pkg.mid": """
+                from pkg.keys import draw
+
+                def noise(k):
+                    return draw(k, (8,))
+            """,
+            "pkg.main": """
+                from pkg.mid import noise
+
+                def sample(key):
+                    return noise(key) + noise(key)
+            """,
+        }, "prng-key-reuse")
+        assert len(hits) == 1
+
+
+# ---------------------------------------------------------------------------
+# GLT008 lock-order-inversion
+# ---------------------------------------------------------------------------
+
+class TestLockOrderInversion:
+    def test_positive_nested_with_inversion(self):
+        src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def f(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def g(self):
+                with self.b:
+                    with self.a:
+                        pass
+        """
+        hits = findings_for(src, "lock-order-inversion")
+        assert len(hits) == 1
+        assert hits[0].severity is Severity.ERROR
+        assert "S.a" in hits[0].message and "S.b" in hits[0].message
+
+    def test_positive_transitive_cross_module_inversion(self):
+        hits = project_findings({
+            "pkg.locks": """
+                import threading
+
+                LOCK_A = threading.Lock()
+                LOCK_B = threading.Lock()
+
+                def take_b():
+                    with LOCK_B:
+                        pass
+
+                def path1():
+                    with LOCK_A:
+                        take_b()
+            """,
+            "pkg.other": """
+                from pkg.locks import LOCK_A, LOCK_B
+
+                def take_a():
+                    with LOCK_A:
+                        pass
+
+                def path2():
+                    with LOCK_B:
+                        take_a()
+            """,
+        }, "lock-order-inversion")
+        assert len(hits) == 1        # one report per inverted pair
+        assert "LOCK_A" in hits[0].message and "LOCK_B" in hits[0].message
+
+    def test_negative_consistent_order(self):
+        src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def f(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def g(self):
+                with self.a:
+                    with self.b:
+                        pass
+        """
+        assert findings_for(src, "lock-order-inversion") == []
+
+    def test_negative_same_lock_reentry_not_reported(self):
+        src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+
+            def f(self):
+                with self.a:
+                    pass
+
+            def g(self):
+                with self.a:
+                    pass
+        """
+        assert findings_for(src, "lock-order-inversion") == []
+
+
+# ---------------------------------------------------------------------------
+# GLT009 blocking-call-while-holding-lock
+# ---------------------------------------------------------------------------
+
+class TestBlockingUnderLock:
+    def test_positive_socket_recv_under_lock(self):
+        src = """
+        import socket
+        import threading
+
+        class Conn:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.sock = socket.socket()
+
+            def fetch(self):
+                with self._lock:
+                    return self.sock.recv(4096)
+        """
+        hits = findings_for(src, "blocking-call-while-holding-lock")
+        assert len(hits) == 1
+        assert "recv" in hits[0].message and "_lock" in hits[0].message
+
+    def test_positive_blocking_helper_called_under_lock(self):
+        # the effect is one call deep: the lock holder calls a helper
+        # whose summary says it may block on a zero-arg get
+        src = """
+        import threading
+
+        def drain(q):
+            return q.get()
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fetch(self, q):
+                with self._lock:
+                    return drain(q)
+        """
+        hits = findings_for(src, "blocking-call-while-holding-lock")
+        assert len(hits) == 1
+        assert "drain" in hits[0].message
+
+    def test_positive_sleep_under_module_lock(self):
+        src = """
+        import threading
+        import time
+
+        _LOCK = threading.Lock()
+
+        def slow():
+            with _LOCK:
+                time.sleep(1.0)
+        """
+        assert len(findings_for(
+            src, "blocking-call-while-holding-lock")) == 1
+
+    def test_negative_blocking_outside_critical_section(self):
+        src = """
+        import socket
+        import threading
+
+        class Conn:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.sock = socket.socket()
+
+            def fetch(self):
+                with self._lock:
+                    n = 4096
+                return self.sock.recv(n)
+        """
+        assert findings_for(src, "blocking-call-while-holding-lock") == []
+
+    def test_negative_liveness_poll_helper_exempt(self):
+        # the GLT007 timeout-and-recheck pattern (bounded_get) is not a
+        # blocking source, even when invoked under a lock
+        src = """
+        import queue
+        import threading
+
+        def bounded(q, thread):
+            while True:
+                try:
+                    return q.get(timeout=0.5)
+                except queue.Empty:
+                    if not thread.is_alive():
+                        raise RuntimeError("source died")
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fetch(self, q, thread):
+                with self._lock:
+                    return bounded(q, thread)
+        """
+        assert findings_for(src, "blocking-call-while-holding-lock") == []
+
+    def test_negative_condition_wait_monitor_pattern(self):
+        src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def wait_ready(self):
+                with self._cv:
+                    self._cv.wait()
+        """
+        assert findings_for(src, "blocking-call-while-holding-lock") == []
+
+    def test_one_finding_per_scope_and_lock(self):
+        src = """
+        import socket
+        import threading
+        import time
+
+        class Conn:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.sock = socket.socket()
+
+            def fetch(self):
+                with self._lock:
+                    time.sleep(0.1)
+                    return self.sock.recv(4096)
+        """
+        assert len(findings_for(
+            src, "blocking-call-while-holding-lock")) == 1
+
+    def test_suppression_with_justification(self):
+        src = """
+        import socket
+        import threading
+
+        class Conn:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.sock = socket.socket()
+
+            def fetch(self):
+                with self._lock:
+                    # Request-response stream; interrupt() is the escape.
+                    # gltlint: disable-next=blocking-call-while-holding-lock
+                    return self.sock.recv(4096)
+        """
+        assert findings_for(src, "blocking-call-while-holding-lock") == []
+
+
+# ---------------------------------------------------------------------------
 # suppression / report plumbing
 # ---------------------------------------------------------------------------
 
@@ -551,17 +1126,33 @@ def test_rule_registry_complete():
         "host-sync-in-jit", "prng-key-reuse", "recompile-hazard",
         "int64-id-truncation", "nondeterministic-default-rng",
         "shadowed-jit-donation", "unbounded-blocking-get",
+        "lock-order-inversion", "blocking-call-while-holding-lock",
     }
 
 
 def test_cli_clean_on_glt_tpu():
     """The shipped tree must lint clean: ``python -m glt_tpu.analysis
-    glt_tpu`` exits 0 (the CI gate)."""
+    glt_tpu`` exits 0 (the CI gate), with the interprocedural passes on."""
     proc = subprocess.run(
         [sys.executable, "-m", "glt_tpu.analysis", "glt_tpu"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "0 error(s)" in proc.stdout
+
+
+def test_cli_perf_guard():
+    """The whole-project analysis (symbols + call graph + effects + all
+    rules) must stay under the CI job's 10 s budget."""
+    import time
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "glt_tpu.analysis", "glt_tpu",
+         "--profile"],
+        cwd=REPO, capture_output=True, text=True, timeout=10)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert elapsed < 10.0, f"gltlint took {elapsed:.1f}s (budget 10s)"
+    assert "total" in proc.stderr       # --profile prints pass timings
 
 
 def test_cli_flags_a_violation(tmp_path):
@@ -587,5 +1178,102 @@ def test_cli_list_rules():
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0
     for code in ("GLT001", "GLT002", "GLT003", "GLT004", "GLT005",
-                 "GLT006", "GLT007"):
+                 "GLT006", "GLT007", "GLT008", "GLT009"):
         assert code in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# output formats + baseline
+# ---------------------------------------------------------------------------
+
+BAD_JIT = """
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    return np.asarray(x)
+"""
+
+
+def _run_cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "glt_tpu.analysis", *args],
+        cwd=cwd, capture_output=True, text=True, timeout=120)
+
+
+class TestOutputFormats:
+    def test_json_format(self, tmp_path):
+        import json
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent(BAD_JIT))
+        proc = _run_cli(str(bad), "--format=json")
+        assert proc.returncode == 1
+        data = json.loads(proc.stdout)
+        assert data["summary"]["errors"] == 1
+        (f,) = data["findings"]
+        assert f["code"] == "GLT001" and f["severity"] == "error"
+        assert f["line"] > 0 and f["path"] == str(bad)
+
+    def test_github_format(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent(BAD_JIT))
+        proc = _run_cli(str(bad), "--format=github")
+        assert proc.returncode == 1
+        assert "::error file=" in proc.stdout
+        assert "title=GLT001" in proc.stdout
+
+    def test_github_format_escapes_newlines(self):
+        from glt_tpu.analysis.report import Finding, format_github
+        f = Finding(path="a.py", line=1, col=1, rule="r", code="GLT001",
+                    severity=Severity.ERROR, message="line1\nline2 100%")
+        out = format_github([f])
+        assert "%0A" in out and "%25" in out and "\nline2" not in out
+
+
+class TestBaseline:
+    def test_write_then_gate_only_on_new(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent(BAD_JIT))
+        baseline = tmp_path / "baseline.json"
+        proc = _run_cli(str(bad), "--write-baseline", str(baseline))
+        assert proc.returncode == 0 and baseline.exists()
+        # the recorded finding no longer gates
+        proc = _run_cli(str(bad), "--baseline", str(baseline))
+        assert proc.returncode == 0, proc.stdout
+        assert "baselined finding(s) hidden" in proc.stdout
+        # ... a new finding still does
+        bad.write_text(textwrap.dedent(BAD_JIT) + textwrap.dedent("""
+            @jax.jit
+            def g(y):
+                return y.sum().item()
+        """))
+        proc = _run_cli(str(bad), "--baseline", str(baseline))
+        assert proc.returncode == 1
+        assert ".item()" in proc.stdout          # only the new finding
+        assert "np.asarray" not in proc.stdout   # old one stays hidden
+
+    def test_baseline_keys_survive_line_drift(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent(BAD_JIT))
+        baseline = tmp_path / "baseline.json"
+        _run_cli(str(bad), "--write-baseline", str(baseline))
+        # prepend unrelated code: every line number shifts
+        bad.write_text("UNRELATED = 1\n\n" + textwrap.dedent(BAD_JIT))
+        proc = _run_cli(str(bad), "--baseline", str(baseline))
+        assert proc.returncode == 0, proc.stdout
+
+    def test_missing_baseline_is_usage_error(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("x = 1\n")
+        proc = _run_cli(str(bad), "--baseline",
+                        str(tmp_path / "nope.json"))
+        assert proc.returncode == 2
+
+    def test_committed_baseline_is_empty(self):
+        """The shipped baseline proves the tree lints clean today — new
+        findings must be fixed or suppressed, not silently baselined."""
+        import json
+        with open(os.path.join(REPO, ".gltlint-baseline.json")) as fh:
+            data = json.load(fh)
+        assert data["findings"] == []
