@@ -51,8 +51,17 @@ import time
 
 import numpy as np
 
+from glt_tpu.obs import prune_unmeasured  # stdlib-only; no jax at import
+
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "benchmarks"))
+
+
+def _round(v, nd):
+    """Round a measured value; ``None`` (not measured) passes through so
+    ``prune_unmeasured`` drops the key — never emit an in-band sentinel
+    like ``-1.0`` (it's indistinguishable from a measured value)."""
+    return None if v is None else round(v, nd)
 
 # Estimated single-A100 sampled-edges/sec (M) for the reference CUDA engine,
 # fanout [15,10,5] batch 1024 (derivation: BASELINE.md "Baseline anchors").
@@ -120,7 +129,7 @@ def _watchdog(deadline_s: float) -> None:
         if not _DONE:
             _progress(f"deadline {deadline_s:.0f}s hit — emitting "
                       f"partial results")
-            out = dict(_PARTIAL)
+            out = prune_unmeasured(dict(_PARTIAL))
             out.setdefault("metric",
                            "neighbor_sampling_throughput_f15_10_5_b1024")
             out.setdefault("value", -1)
@@ -529,6 +538,65 @@ def main():
         "gather_gb_s_dedup_cache": round(gather_gb_s["dedup_cache"], 3),
     })
 
+    # --- memcpy roofline (ISSUE 6 / ROADMAP item 1's success metric):
+    # the measured streaming-copy ceiling of THIS device through THIS
+    # runtime, so the gather bandwidths above read as achieved-vs-peak
+    # fractions rather than fractions of a datasheet constant
+    # (est_hbm_fraction/819 GB/s) the tunnel-dispatched runtime may never
+    # reach.  Methodology: glt_tpu/obs/roofline.py.
+    _progress("memcpy roofline")
+    from glt_tpu.obs.roofline import measure_memcpy_roofline, roofline_fraction
+
+    roof = measure_memcpy_roofline(nbytes=1 << 22 if small else 1 << 27,
+                                   iters=3 if small else 10)
+    memcpy_roofline_gb_s = roof["memcpy_gb_s"]
+    gather_roofline_frac = roofline_fraction(gather_gb_s[gather_best],
+                                             memcpy_roofline_gb_s)
+    _PARTIAL.update({
+        "memcpy_roofline_gb_s": round(memcpy_roofline_gb_s, 2),
+        "gather_roofline_frac": round(gather_roofline_frac, 4),
+    })
+
+    # --- obs overhead (ISSUE 6 acceptance: metrics-disabled overhead on
+    # the serial step < 2%): (a) the measured per-call cost of a disabled
+    # span + histogram-timer + counter-inc triple; (b) the serial step
+    # re-run with that triple at the host boundary, A/B against the
+    # uninstrumented serial loop above.
+    _progress("obs disabled-overhead (no-op probe + serial step A/B)")
+    from glt_tpu.obs import metrics as obs_metrics
+    from glt_tpu.obs.trace import span as obs_span
+
+    obs_metrics.disable()
+    _c_probe = obs_metrics.counter("glt.bench.noop_probe", "overhead probe")
+    _h_probe = obs_metrics.histogram("glt.bench.noop_probe_ms")
+    noop_n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(noop_n):
+        with obs_span("noop"), _h_probe.time():
+            _c_probe.inc()
+    obs_noop_ns = (time.perf_counter() - t0) / noop_n * 1e9
+    st = capped["_handles"][2]
+    tstep_c, gather_j_c = capped["_handles"][3], capped["_handles"][4]
+    sample_first_c = capped["_handles"][1]
+    t0 = time.perf_counter()
+    for i in range(t_iters):
+        with obs_span("bench.serial_step"), _h_probe.time():
+            o = sample_first_c(batches[(WARMUP + i) % len(batches)],
+                               jax.random.fold_in(base, 700 + i))
+            x, y = gather_j_c(o)
+            st, l, _ = tstep_c(st, to_batch(o, x=x, y=y,
+                                            batch_size=BATCH))
+            _c_probe.inc()
+    sync(l)
+    serial_obs_ms = (time.perf_counter() - t0) / t_iters * 1e3
+    obs_overhead_frac = (serial_obs_ms
+                         / max(capped["serial_step_ms"], 1e-9) - 1.0)
+    _PARTIAL.update({
+        "obs_noop_ns_per_call": round(obs_noop_ns, 1),
+        "serial_step_ms_obs_disabled": round(serial_obs_ms, 2),
+        "obs_disabled_overhead_frac": round(obs_overhead_frac, 4),
+    })
+
     # Tiled-DMA Pallas kernel A/B at its native width (d % 128 == 0): pad
     # the feature rows to 128 columns and race the kernel against XLA's
     # gather on a real sampled id pattern.  The per-(width, batch, dtype)
@@ -539,7 +607,9 @@ def main():
         gather_rows_pallas,
     )
 
-    kernel_choice, t_xla128, t_pal128 = "xla", -1.0, -1.0
+    # None = not measured on this backend (omitted from the JSON — the
+    # sentinel-leak fix; see prune_unmeasured).
+    kernel_choice, t_xla128, t_pal128 = "xla", None, None
     if jax.default_backend() == "tpu":
         hot128 = jnp.pad(hot, ((0, 0), (0, 128 - dim % 128)))
         probe = jnp.clip(gouts[0].node.astype(jnp.int32), 0, n - 1)
@@ -561,11 +631,13 @@ def main():
             _progress(f"pallas A/B failed ({e!r}); pinning xla")
         # Seed the decision table so any later force='auto' call agrees.
         autotune_gather_rows(hot128, probe)
-    _PARTIAL.update({
-        "gather_xla_ms_d128": round(t_xla128 * 1e3, 3),
-        "gather_pallas_ms_d128": round(t_pal128 * 1e3, 3),
+    _PARTIAL.update(prune_unmeasured({
+        "gather_xla_ms_d128": _round(
+            None if t_xla128 is None else t_xla128 * 1e3, 3),
+        "gather_pallas_ms_d128": _round(
+            None if t_pal128 is None else t_pal128 * 1e3, 3),
         "gather_kernel_choice": kernel_choice,
-    })
+    }))
 
     # Pick the winner per-measurement (VERDICT r4 weak #2): fused vs
     # back-to-back queued programs.
@@ -583,7 +655,14 @@ def main():
     seed_batches_ep = [
         jnp.asarray(rng_ep.integers(0, n, BATCH).astype(np.int32))
         for _ in range(n_epoch_batches)]
-    overflow_rate = -1.0
+    # GLT_OBS_TRACE=/path.json captures a Chrome trace of this measured
+    # epoch (the epoch drivers + loaders are span-instrumented); view in
+    # ui.perfetto.dev or `python -m glt_tpu.obs summarize`.
+    obs_trace_path = os.environ.get("GLT_OBS_TRACE")
+    if obs_trace_path:
+        from glt_tpu.obs import start_trace, stop_trace
+        start_trace()
+    overflow_rate = None    # omitted if the sampler has no overflow channel
     t0 = time.perf_counter()
     if best_path == "fused":
         stats = {}
@@ -600,16 +679,21 @@ def main():
         st = state0
         flags = []
         for i, sd in enumerate(seed_batches_ep):
-            o = sample_first(sd, jax.random.fold_in(base, 5000 + i))
-            if o.metadata:
-                flags.append(o.metadata["overflow"])
-            x, y = gather_j(o)
-            st, l, _ = tstep(st, to_batch(o, x=x, y=y, batch_size=BATCH))
+            with obs_span("bench.serial_epoch_step"):
+                o = sample_first(sd, jax.random.fold_in(base, 5000 + i))
+                if o.metadata:
+                    flags.append(o.metadata["overflow"])
+                x, y = gather_j(o)
+                st, l, _ = tstep(st, to_batch(o, x=x, y=y,
+                                              batch_size=BATCH))
         sync(l)
         epoch_s = time.perf_counter() - t0
         if flags:
             overflow_rate = float(np.asarray(
                 jax.device_get(jnp.stack(flags))).mean())
+    if obs_trace_path:
+        stop_trace(obs_trace_path)
+        _progress(f"obs trace written to {obs_trace_path}")
 
     # --- scanned G-batch epoch: one program trains G=8 consecutive
     # batches under lax.scan (the trick that bought 7x/17x on the
@@ -777,7 +861,9 @@ def main():
 
     global _DONE
     _DONE = True
-    print(json.dumps({
+    # Unmeasured metrics are None and PRUNED from the line — the JSON
+    # omits what this run didn't measure instead of leaking sentinels.
+    print(json.dumps(prune_unmeasured({
         "metric": "neighbor_sampling_throughput_f15_10_5_b1024",
         "value": round(edges_per_sec_m, 3),
         "unit": "M sampled edges/s",
@@ -817,8 +903,14 @@ def main():
         "gather_gb_s_naive": round(gather_gb_s["naive"], 3),
         "gather_gb_s_dedup": round(gather_gb_s["dedup"], 3),
         "gather_gb_s_dedup_cache": round(gather_gb_s["dedup_cache"], 3),
-        "gather_xla_ms_d128": round(t_xla128 * 1e3, 3),
-        "gather_pallas_ms_d128": round(t_pal128 * 1e3, 3),
+        # Achieved-vs-peak (ISSUE 6): the measured memcpy ceiling and the
+        # winning gather variant's fraction of it.
+        "memcpy_roofline_gb_s": round(memcpy_roofline_gb_s, 2),
+        "gather_roofline_frac": round(gather_roofline_frac, 4),
+        "gather_xla_ms_d128": _round(
+            None if t_xla128 is None else t_xla128 * 1e3, 3),
+        "gather_pallas_ms_d128": _round(
+            None if t_pal128 is None else t_pal128 * 1e3, 3),
         "gather_kernel_choice": kernel_choice,
         "train_ms": round(full["train_ms"], 2),
         "serial_step_ms": round(full["serial_step_ms"], 2),
@@ -832,7 +924,7 @@ def main():
         "node_cap_full": cap,
         "node_cap_calibrated": node_cap,
         "cap_fraction": round(node_cap / cap, 3),
-        "overflow_rate": round(overflow_rate, 4),
+        "overflow_rate": _round(overflow_rate, 4),
         # Flagship config (occupancy cap + bf16 matmuls).
         "sample_ms_capped": round(capped["sample_ms"], 2),
         "gather_ms_capped": round(capped["gather_ms"], 2),
@@ -880,7 +972,11 @@ def main():
         "epoch_batches": n_epoch_batches,
         "epoch_s_est_config1": round(n_epoch_batches * best_step_ms / 1e3,
                                      2),
-    }))
+        # Obs instrumentation cost (ISSUE 6 acceptance: < 2% disabled).
+        "obs_noop_ns_per_call": round(obs_noop_ns, 1),
+        "serial_step_ms_obs_disabled": round(serial_obs_ms, 2),
+        "obs_disabled_overhead_frac": round(obs_overhead_frac, 4),
+    })))
 
 
 if __name__ == "__main__":

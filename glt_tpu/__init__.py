@@ -18,6 +18,7 @@ Subpackages:
   distributed  host-side deployment: mp producers, shm channel loader,
              TCP server-client
   channel    SampleMessage serialization + native shm ring queue
+  obs        tracing (Chrome-trace spans), metrics registry, roofline
   utils      topo/tensor helpers, profiler, checkpointing
 """
 
@@ -29,7 +30,7 @@ from .typing import EdgeType, NodeType, PADDING_ID  # noqa: F401
 # Subpackages import jax/flax; keep them lazy so `import glt_tpu` is cheap
 # and usable for pure-host tooling (partitioning scripts etc.).
 _SUBMODULES = ("data", "ops", "sampler", "loader", "models", "parallel",
-               "partition", "distributed", "channel", "utils")
+               "partition", "distributed", "channel", "obs", "utils")
 
 
 def __getattr__(name):

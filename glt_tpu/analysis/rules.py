@@ -948,6 +948,111 @@ class UnboundedBlockingGet(Rule):
         return findings
 
 
+# ---------------------------------------------------------------------------
+# GLT010 span-in-traced-code
+# ---------------------------------------------------------------------------
+
+@register
+class SpanInTracedCode(Rule):
+    """``glt_tpu.obs`` span/metric host calls inside jit-traced functions.
+
+    The obs library is host-side: a ``span()`` / ``Counter.inc()`` inside
+    a jit-traced function executes ONCE at trace time and then vanishes
+    from the compiled program — the span measures tracing, the counter
+    counts compilations, and both silently stop moving as soon as the
+    cached executable is reused.  Instrument at the host call boundary
+    (loaders, epoch drivers, dispatch wrappers) and fence device work
+    with ``span.fence(out)`` instead.
+
+    Flagged spellings, inside any scope :meth:`ModuleInfo.in_jit_context`
+    marks traced:
+
+      * any call resolving (through the import map) into ``glt_tpu.obs``
+        — ``span(...)``, ``obs.span(...)``, ``metrics.counter(...)``;
+      * ``.inc()/.observe()/.set()/.time()/.fence()`` on a name assigned
+        from an obs factory in this module (module-level ``_M = ...`` or
+        ``self._m = ...`` instruments) or chained directly off one
+        (``metrics.counter("x").inc()``).
+
+    ``.at[i].set(v)`` and other non-obs receivers never match: the
+    receiver must trace back to an obs import or an obs-built name.
+    """
+    name = "span-in-traced-code"
+    code = "GLT010"
+    severity = Severity.ERROR
+    description = ("glt_tpu.obs span/metric call inside a jit-traced "
+                   "function (host side effects vanish under trace)")
+
+    _OBS_PREFIX = "glt_tpu.obs"
+    _METHODS = {"inc", "observe", "set", "time", "fence"}
+
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
+        instruments = self._instrument_names(module)
+        findings: List[Finding] = []
+        for scope in module.scopes:
+            if not module.in_jit_context(scope):
+                continue
+            for node in _walk_own(scope.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                message = self._obs_call(module, node, instruments)
+                if message:
+                    findings.append(self.finding(module, node, message))
+        return findings
+
+    def _is_obs_path(self, dotted: Optional[str]) -> bool:
+        return bool(dotted) and (
+            dotted == self._OBS_PREFIX
+            or dotted.startswith(self._OBS_PREFIX + "."))
+
+    def _instrument_names(self, module: ModuleInfo) -> Set[str]:
+        """Names (plain or ``self.x`` dotted) assigned from an obs
+        factory call anywhere in the module."""
+        out: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if not (isinstance(value, ast.Call)
+                    and self._is_obs_path(module.call_name(value))):
+                continue
+            out |= set(assign_targets(node))
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                d = _dotted(t)
+                if d:
+                    out.add(d)
+        return out
+
+    def _obs_call(self, module: ModuleInfo, call: ast.Call,
+                  instruments: Set[str]) -> Optional[str]:
+        resolved = module.call_name(call)
+        if self._is_obs_path(resolved):
+            return (f"{resolved}() inside a jit-traced function: the host "
+                    f"call runs once at trace time and vanishes from the "
+                    f"compiled program — instrument the host dispatch "
+                    f"loop instead (span.fence(out) observes device time)")
+        func = call.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in self._METHODS):
+            return None
+        receiver = _dotted(func.value)
+        if receiver is not None and receiver in instruments:
+            return (f".{func.attr}() on obs instrument {receiver!r} "
+                    f"inside a jit-traced function: the host side effect "
+                    f"vanishes under trace — move it to the host loop")
+        inner = func.value
+        while isinstance(inner, ast.Attribute):
+            inner = inner.value
+        if (isinstance(inner, ast.Call)
+                and self._is_obs_path(module.call_name(inner))):
+            return (f".{func.attr}() chained off an obs factory inside a "
+                    f"jit-traced function: the host side effect vanishes "
+                    f"under trace — move it to the host loop")
+        return None
+
+
 def _iter_const_ints(node: ast.expr) -> Iterator[int]:
     if isinstance(node, ast.Constant) and isinstance(node.value, int):
         yield node.value

@@ -140,13 +140,23 @@ def cache_gather(state: FeatureCacheState, ids: jnp.ndarray,
         misses=state.misses + jnp.sum(miss.astype(jnp.int32))), rows
 
 
-def cache_stats(state: FeatureCacheState) -> dict:
-    """Fetch counters to host (SYNC POINT — call outside timed regions)."""
+def publish_cache_stats(state: FeatureCacheState,
+                        namespace: str = "glt.cache") -> dict:
+    """Fetch counters to host and publish them as ``glt.cache.*`` gauges.
+
+    SYNC POINT — call outside timed regions.  This is the canonical read:
+    the returned dict is also mirrored into the
+    :mod:`glt_tpu.obs.metrics` registry (when metrics are enabled) so
+    the cache shows up in one namespace next to loader/remote/server
+    counters instead of through ad-hoc dict plumbing.
+    """
     import numpy as np
+
+    from ..obs import metrics as _metrics
 
     h = int(np.asarray(state.hits))
     m = int(np.asarray(state.misses))
-    return {
+    stats = {
         "hits": h,
         "misses": m,
         "lookups": h + m,
@@ -155,3 +165,20 @@ def cache_stats(state: FeatureCacheState) -> dict:
         "resident": int(np.asarray(
             jnp.sum((state.slot_ids[:-1] >= 0).astype(jnp.int32)))),
     }
+    if _metrics.enabled():
+        for k, v in stats.items():
+            _metrics.gauge(f"{namespace}.{k}",
+                           "feature cache counter (device-scalar fetch)"
+                           ).set(v)
+    return stats
+
+
+def cache_stats(state: FeatureCacheState) -> dict:
+    """Deprecated alias of :func:`publish_cache_stats`.
+
+    Kept for back-compat; new code should read the cache through the
+    unified metrics namespace (``obs.metrics.snapshot()['glt.cache.*']``
+    after a :func:`publish_cache_stats` call) rather than plumb this
+    dict ad hoc.
+    """
+    return publish_cache_stats(state)

@@ -32,6 +32,8 @@ import numpy as np
 from ..channel.base import QueueSourceDied, bounded_get, bounded_put
 from ..channel.serialization import deserialize
 from ..loader.transform import Batch
+from ..obs import metrics as _metrics
+from ..obs.trace import span as _span
 from .dist_server import (
     _KIND_JSON,
     _KIND_MSG,
@@ -41,6 +43,34 @@ from .dist_server import (
     send_frame,
 )
 from .sample_message import message_to_batch
+
+
+# Remote-loader metrics (docs/observability.md "glt.remote.*"): the
+# canonical cross-epoch view of the sequence-number accounting that
+# ``epoch_stats`` snapshots per epoch.
+_M_RECEIVED = _metrics.counter(
+    "glt.remote.batches_received", "unique sampled messages received")
+_M_DUPLICATES = _metrics.counter(
+    "glt.remote.duplicates", "replayed messages suppressed client-side")
+_M_RECONNECTS = _metrics.counter(
+    "glt.remote.reconnects", "socket reconnects (backoff/failover)")
+_M_EPOCHS = _metrics.counter(
+    "glt.remote.epochs", "remote sampling epochs completed")
+
+
+def publish_epoch_stats(stats: dict) -> dict:
+    """Fold one epoch's seq accounting into the ``glt.remote.*`` counters.
+
+    The unified read for what ``RemoteNeighborLoader.epoch_stats``
+    exposes per epoch (that attribute remains as a back-compat alias —
+    the chaos suite asserts exactly-once delivery from it).  Returns
+    ``stats`` unchanged.
+    """
+    _M_RECEIVED.inc(stats.get("received", 0))
+    _M_DUPLICATES.inc(stats.get("duplicates", 0))
+    _M_RECONNECTS.inc(stats.get("reconnects", 0))
+    _M_EPOCHS.inc()
+    return stats
 
 
 class UnknownProducerError(RuntimeError):
@@ -258,7 +288,10 @@ class RemoteNeighborLoader:
 
     After each epoch, ``epoch_stats`` records the sequence-number
     accounting: ``{"received", "duplicates", "reconnects", "seqs"}`` —
-    the chaos suite asserts exactly-once delivery from it.
+    the chaos suite asserts exactly-once delivery from it.  The same
+    numbers also fold into the unified ``glt.remote.*`` counters
+    (:func:`publish_epoch_stats`); prefer reading those —
+    ``epoch_stats`` is kept as a back-compat alias.
     """
 
     def __init__(
@@ -370,17 +403,19 @@ class RemoteNeighborLoader:
         t = threading.Thread(target=prefetcher, daemon=True)
         t.start()
         try:
-            for _ in range(self.num_expected):
-                try:
-                    item = bounded_get(buf, alive=t.is_alive, poll=0.2)
-                except QueueSourceDied:
-                    raise RuntimeError(
-                        "remote sampling prefetch thread died "
-                        "unexpectedly") from None
-                if isinstance(item, Exception):
-                    raise RuntimeError(
-                        f"remote sampling prefetch failed: {item}") from item
-                yield message_to_batch(item)
+            with _span("remote.epoch", epoch=epoch):
+                for _ in range(self.num_expected):
+                    try:
+                        item = bounded_get(buf, alive=t.is_alive, poll=0.2)
+                    except QueueSourceDied:
+                        raise RuntimeError(
+                            "remote sampling prefetch thread died "
+                            "unexpectedly") from None
+                    if isinstance(item, Exception):
+                        raise RuntimeError(
+                            f"remote sampling prefetch failed: {item}"
+                        ) from item
+                    yield message_to_batch(item)
         finally:
             stop.set()
             # Join the prefetcher: one still blocked inside fetch_message
@@ -394,7 +429,8 @@ class RemoteNeighborLoader:
                 self.conn.interrupt()
                 t.join(timeout=2.0)
             stats["reconnects"] = self.conn.reconnects - reconnects_before
-            self.epoch_stats = stats
+            # Back-compat alias; the metrics registry is the unified view.
+            self.epoch_stats = publish_epoch_stats(stats)
 
     def shutdown(self, exit_server: bool = False) -> None:
         try:

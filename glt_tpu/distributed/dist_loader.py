@@ -17,6 +17,8 @@ import numpy as np
 from ..channel import ShmChannel
 from ..loader.node_loader import NeighborLoader
 from ..loader.transform import Batch
+from ..obs import metrics as _metrics
+from ..obs.trace import span as _span
 from .dist_options import (
     CollocatedSamplingWorkerOptions,
     MpSamplingWorkerOptions,
@@ -117,9 +119,14 @@ class _DistLoaderBase:
             return
         # epoch protocol (cf. dist_loader.py:259-272); iter_messages
         # survives mid-epoch worker death (recv heartbeat + seed reissue).
-        self._producer.produce_all()
-        for msg in self._producer.iter_messages():
-            yield message_to_batch(msg)
+        with _span("dist_loader.mp_epoch"):
+            self._producer.produce_all()
+            mp_batches = _metrics.counter(
+                "glt.loader.mp_batches",
+                "batches received over the shm channel (mp mode)")
+            for msg in self._producer.iter_messages():
+                mp_batches.inc()
+                yield message_to_batch(msg)
 
     def __len__(self) -> int:
         if self._inner is not None:

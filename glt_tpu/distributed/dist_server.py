@@ -44,7 +44,23 @@ import numpy as np
 
 from ..channel.base import QueueSourceDied, bounded_get, bounded_put
 from ..channel.serialization import deserialize, serialize
+from ..obs import metrics as _metrics
 from ..testing.faults import FaultPlan, ProducerKilled
+
+# Server metrics (docs/observability.md "glt.server.*"): the production
+# window into the PR-4 fault-tolerance machinery (seqs, replays, leases).
+# Counters move only while ``obs.metrics`` is enabled; the ``get_metrics``
+# op serves the Prometheus text exposition either way.
+_M_MESSAGES = _metrics.counter(
+    "glt.server.messages_sent", "sampled message frames sent")
+_M_REPLAYS = _metrics.counter(
+    "glt.server.replays", "unacked messages resent from the replay window")
+_M_REAPED = _metrics.counter(
+    "glt.server.producers_reaped", "producers GC'd by lease expiry")
+_M_CREATED = _metrics.counter(
+    "glt.server.producers_created", "sampling producers created")
+_M_ERRORS = _metrics.counter(
+    "glt.server.request_errors", "structured per-request failures")
 
 _KIND_JSON = 0
 _KIND_MSG = 1
@@ -316,9 +332,11 @@ class _Producer:
                     code="sampling_failed")
             while self._retained and self._retained[0][0] <= ack:
                 self._retained.popleft()
-            if self._retained:
-                # Sent but never received: resume from the oldest gap.
-                return self._retained[0]
+            resend = self._retained[0] if self._retained else None
+        if resend is not None:
+            # Sent but never received: resume from the oldest gap.
+            _M_REPLAYS.inc()
+            return resend
         try:
             item = self._pop_current(epoch)
         except QueueSourceDied:
@@ -369,8 +387,14 @@ class DistServer:
                  num_clients: int = 0,
                  max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
                  reap_interval: float = 0.25,
-                 fault_plan: Optional[FaultPlan] = None):
+                 fault_plan: Optional[FaultPlan] = None,
+                 enable_metrics: bool = False):
         from .dist_context import _set_default, make_server_context
+
+        if enable_metrics:
+            # Serving deployments opt in: flips the PROCESS-wide metrics
+            # switch so the get_metrics exposition carries live counters.
+            _metrics.enable()
 
         self.dataset = dataset
         self._dataset_builder = dataset_builder
@@ -434,10 +458,24 @@ class DistServer:
                         del self._client_keys[ck]
             for _, prod in expired:
                 prod.stop()
+            if expired:
+                _M_REAPED.inc(len(expired))
 
     def live_producers(self) -> int:
         with self._lock:
             return len(self._producers)
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the whole ``glt.*`` namespace.
+
+        Point-in-time gauges (live producer count) are refreshed here so
+        a scrape always sees current occupancy; served to clients by the
+        ``get_metrics`` op.
+        """
+        _metrics.gauge("glt.server.live_producers",
+                       "producers currently registered"
+                       ).set(self.live_producers())
+        return _metrics.render_prometheus()
 
     # -- request handlers (cf. _call_func_on_server, dist_server.py:214) ---
     def _handle(self, req: dict):
@@ -481,8 +519,16 @@ class DistServer:
                 # Same client re-created (reconnect after lease GC raced,
                 # or a restart): its previous fleet must not leak.
                 stale.stop()
+            _M_CREATED.inc()
             return {"producer_id": pid,
                     "num_expected": prod.num_expected()}
+        if op == "get_metrics":
+            # Prometheus-style text exposition (docs/observability.md):
+            # a scrape sidecar (or a curl over the framed protocol) reads
+            # the whole glt.* namespace — producer/lease/replay counters
+            # included — without touching producer state.
+            return {"text": self.metrics_text(),
+                    "enabled": _metrics.enabled()}
         if op == "start_new_epoch_sampling":
             self._get_producer(req).start_epoch(int(req.get("epoch", 0)))
             return {"ok": True}
@@ -521,6 +567,9 @@ class DistServer:
                 if kind is None:
                     return
                 req = json.loads(data)
+                _metrics.counter(
+                    "glt.server.requests", "requests handled, by op",
+                    labels={"op": str(req.get("op"))}).inc()
                 try:
                     if req["op"] == "fetch_one_sampled_message":
                         prod = self._get_producer(req)
@@ -529,6 +578,7 @@ class DistServer:
                             int(req.get("epoch", 0)))
                         send_frame(conn, _KIND_MSG,
                                    struct.pack("<Q", seq) + payload)
+                        _M_MESSAGES.inc()
                     else:
                         resp = self._handle(req)
                         send_frame(conn, _KIND_JSON,
@@ -537,6 +587,7 @@ class DistServer:
                     # Structured per-request failure: report it and keep
                     # the connection serving — the framed stream is still
                     # in sync.
+                    _M_ERRORS.inc()
                     send_frame(conn, _KIND_JSON, json.dumps(
                         {"error": str(e), "code": e.code}).encode())
         except Exception as e:  # desync/socket errors end the session
@@ -578,7 +629,8 @@ def init_server(dataset, host: str = "127.0.0.1", port: int = 0,
                 num_clients: int = 0,
                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
                 reap_interval: float = 0.25,
-                fault_plan: Optional[FaultPlan] = None) -> DistServer:
+                fault_plan: Optional[FaultPlan] = None,
+                enable_metrics: bool = False) -> DistServer:
     """Start a sampling server (cf. init_server, dist_server.py:158-190).
 
     Pass a picklable ``dataset_builder`` (+``builder_args``) to enable
@@ -590,6 +642,9 @@ def init_server(dataset, host: str = "127.0.0.1", port: int = 0,
     beyond it); ``fault_plan`` wires a deterministic
     :class:`~glt_tpu.testing.faults.FaultPlan` into every accepted
     connection and producer thread (chaos testing only).
+    ``enable_metrics=True`` flips the process-wide
+    :mod:`glt_tpu.obs.metrics` switch so the ``get_metrics`` op's
+    Prometheus exposition carries live ``glt.server.*`` counters.
     """
     return DistServer(dataset, host=host, port=port,
                       dataset_builder=dataset_builder,
@@ -598,4 +653,5 @@ def init_server(dataset, host: str = "127.0.0.1", port: int = 0,
                       num_clients=num_clients,
                       max_frame_bytes=max_frame_bytes,
                       reap_interval=reap_interval,
-                      fault_plan=fault_plan)
+                      fault_plan=fault_plan,
+                      enable_metrics=enable_metrics)

@@ -20,10 +20,26 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data.dataset import Dataset
+from ..obs import metrics as _metrics
+from ..obs.trace import span as _span
 from ..sampler.base import NodeSamplerInput
 from ..sampler.neighbor_sampler import NeighborSampler
 from ..typing import PADDING_ID
 from .transform import Batch, to_batch
+
+# Host-boundary instrumentation (docs/observability.md): the dispatch
+# span measures async enqueue cost only — device completion is observed
+# by the consumer's own sync, never forced here (a per-batch fence would
+# serialize the prefetch pipeline this loader exists to keep full).
+_M_BATCHES = _metrics.counter(
+    "glt.loader.batches", "batches delivered by Node/NeighborLoader")
+_M_OVERFLOW = _metrics.counter(
+    "glt.loader.overflow_batches",
+    "occupancy-capped batches re-sampled at full capacity")
+_M_SAMPLE_MS = _metrics.histogram(
+    "glt.loader.sample_dispatch_ms", "sampler dispatch wall per batch")
+_M_COLLATE_MS = _metrics.histogram(
+    "glt.loader.collate_ms", "feature/label collate dispatch per batch")
 
 
 class NodeLoader:
@@ -114,8 +130,10 @@ class NodeLoader:
                     seeds = next(batches, None)
                     if seeds is None:
                         break
-                    out = self.sampler.sample_from_nodes(
-                        NodeSamplerInput(seeds))
+                    with _span("loader.sample_dispatch"), \
+                            _M_SAMPLE_MS.time():
+                        out = self.sampler.sample_from_nodes(
+                            NodeSamplerInput(seeds))
                     # Deferred-flag pattern (cf. run_pipelined_epoch):
                     # start the flag's D2H copy at dispatch so the
                     # strict check at pop time resolves a transfer that
@@ -127,7 +145,10 @@ class NodeLoader:
                     return
                 out, nseeds = pending.popleft()
                 out = self._maybe_refetch_overflow(out)
-                yield self._collate_fn(out, nseeds)
+                with _span("loader.collate"), _M_COLLATE_MS.time():
+                    batch = self._collate_fn(out, nseeds)
+                _M_BATCHES.inc()
+                yield batch
         finally:
             pending.clear()
 
@@ -173,6 +194,7 @@ class NodeLoader:
         if not bool(np.asarray(jax.device_get(out.metadata["overflow"]))):
             return out
         self.overflow_batches += 1
+        _M_OVERFLOW.inc()
         return self.sampler.full_capacity_sibling().sample_from_nodes(
             NodeSamplerInput(out.batch))
 

@@ -15,7 +15,21 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ..obs import metrics as _metrics
+from ..obs.trace import span as _span
 from ..typing import PADDING_ID
+
+# Epoch-driver instrumentation (docs/observability.md).  Only the HOST
+# loops are instrumented — the jitted step bodies must stay span-free
+# (gltlint GLT010: a span inside a traced function runs once at trace
+# time and vanishes from the compiled program).
+_M_STEPS = _metrics.counter(
+    "glt.train.steps", "train steps dispatched by the epoch drivers")
+_M_EPOCHS = _metrics.counter(
+    "glt.train.epochs", "epochs driven (pipelined + scanned)")
+_M_STEP_MS = _metrics.histogram(
+    "glt.train.step_dispatch_ms",
+    "per-step host dispatch wall in the pipelined epoch driver")
 
 
 class TrainState(NamedTuple):
@@ -315,6 +329,11 @@ def run_pipelined_epoch(step, sample_first, seed_batches, state,
     ``stats['overflow_flags']`` collects each batch's device overflow
     scalar (no per-batch sync — fetch after the epoch and report the
     rate; overflow batches trained with their excess-node edges masked).
+
+    With tracing enabled (``obs.start_trace()``) the epoch span fences
+    on the last loss — ONE extra device sync at epoch end so the trace
+    records real completion, not the last enqueue.  Per-step spans
+    measure dispatch only and never sync.
     """
     import jax.numpy as jnp
 
@@ -322,25 +341,36 @@ def run_pipelined_epoch(step, sample_first, seed_batches, state,
     flags = None if stats is None else stats.setdefault("overflow_flags", [])
     out = None
     first = None
-    for i, seeds in enumerate(seed_batches):
-        seeds = jnp.asarray(seeds)
-        k = jax.random.fold_in(base_key, i)
-        if out is None:
-            out = sample_first(seeds, k)
-            first = seeds
-            continue
-        if flags is not None and out.metadata:
-            flags.append(out.metadata.get("overflow"))
-        state, loss, acc, out = step(state, out, seeds, k)
-        losses.append(loss)
-        accs.append(acc)
-    if out is not None:
-        if flags is not None and out.metadata:
-            flags.append(out.metadata.get("overflow"))
-        state, loss, acc, _ = step(state, out, first,
-                                   jax.random.fold_in(base_key, 2**31 - 1))
-        losses.append(loss)
-        accs.append(acc)
+    with _span("train.pipelined_epoch") as ep:
+        for i, seeds in enumerate(seed_batches):
+            seeds = jnp.asarray(seeds)
+            k = jax.random.fold_in(base_key, i)
+            if out is None:
+                out = sample_first(seeds, k)
+                first = seeds
+                continue
+            if flags is not None and out.metadata:
+                flags.append(out.metadata.get("overflow"))
+            with _span("train.step_dispatch"), _M_STEP_MS.time():
+                state, loss, acc, out = step(state, out, seeds, k)
+            _M_STEPS.inc()
+            losses.append(loss)
+            accs.append(acc)
+        if out is not None:
+            if flags is not None and out.metadata:
+                flags.append(out.metadata.get("overflow"))
+            with _span("train.step_dispatch"), _M_STEP_MS.time():
+                state, loss, acc, _ = step(
+                    state, out, first,
+                    jax.random.fold_in(base_key, 2**31 - 1))
+            _M_STEPS.inc()
+            losses.append(loss)
+            accs.append(acc)
+        if losses:
+            # The epoch span closes on real device completion, not on the
+            # dispatch of the last enqueue (bench.py:33 tunnel caveat).
+            ep.fence(losses[-1])
+    _M_EPOCHS.inc()
     return state, losses, accs
 
 
@@ -479,14 +509,21 @@ def run_scanned_epoch(step, state, train_idx, batch_size: int,
               for b in node_seed_blocks(train_idx, batch_size, group, rng)]
     n_real = -(-len(train_idx) // batch_size)
     losses, accs, ovfs = [], [], []
-    for i, blk in enumerate(blocks):
-        res = step(state, blk, jax.random.fold_in(base_key, i))
-        state = res[0]
-        losses.append(res[1])
-        accs.append(res[2])
-        if len(res) > 3:
-            ovfs.append(res[3])
-    losses = np.asarray(jax.device_get(jnp.concatenate(losses)))[:n_real]
+    with _span("train.scanned_epoch", blocks=len(blocks)):
+        for i, blk in enumerate(blocks):
+            with _span("train.scanned_block_dispatch"):
+                res = step(state, blk, jax.random.fold_in(base_key, i))
+            _M_STEPS.inc()
+            state = res[0]
+            losses.append(res[1])
+            accs.append(res[2])
+            if len(res) > 3:
+                ovfs.append(res[3])
+        _M_EPOCHS.inc()
+        # The epoch's own host fetch below is the sync; the span closes
+        # around it so the scanned epoch's trace duration is truthful.
+        losses = np.asarray(jax.device_get(
+            jnp.concatenate(losses)))[:n_real]
     accs = np.asarray(jax.device_get(jnp.concatenate(accs)))[:n_real]
     ovf = (int(np.asarray(jax.device_get(
         jnp.concatenate(ovfs))).sum()) if ovfs else 0)

@@ -1,0 +1,57 @@
+"""glt_tpu.obs — unified tracing, metrics, and roofline profiling.
+
+The library-wide observability subsystem (docs/observability.md):
+
+  * **Tracing** (:mod:`.trace`): nested host-side spans with explicit
+    device fencing, exported as Chrome-trace/Perfetto JSON; summarize
+    with ``python -m glt_tpu.obs summarize trace.json``.
+  * **Metrics** (:mod:`.metrics`): counters/gauges/histograms under one
+    ``glt.*`` namespace with near-zero-cost no-op defaults; Prometheus
+    text exposition serves the ``get_metrics`` op on ``DistServer``.
+  * **Roofline** (:mod:`.roofline`): a measured device-memcpy bandwidth
+    ceiling so ``gather_gb_s`` becomes an achieved-vs-peak fraction.
+
+Both tracing and metrics are OFF by default and cost roughly a global
+read + branch per call site when off.  Everything here is **host-side**:
+never call span()/inc() inside a jit-traced function (gltlint GLT010).
+
+>>> from glt_tpu import obs
+>>> obs.metrics.enable()
+>>> tracer = obs.start_trace()
+>>> with obs.span("epoch") as sp:
+...     loss = step(...)
+...     sp.fence(loss)                    # close waits for the device
+>>> obs.stop_trace("/tmp/trace.json")
+>>> obs.metrics.snapshot()["glt.loader.batches"]
+"""
+from . import metrics  # noqa: F401  (stdlib-only; safe without jax)
+from .metrics import prune_unmeasured  # noqa: F401
+from .roofline import measure_memcpy_roofline, roofline_fraction  # noqa: F401
+from .summarize import format_summary, summarize_trace  # noqa: F401
+from .trace import (  # noqa: F401
+    Span,
+    Tracer,
+    current,
+    install,
+    span,
+    start_trace,
+    stop_trace,
+    validate_chrome_trace,
+)
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "current",
+    "format_summary",
+    "install",
+    "measure_memcpy_roofline",
+    "metrics",
+    "prune_unmeasured",
+    "roofline_fraction",
+    "span",
+    "start_trace",
+    "stop_trace",
+    "summarize_trace",
+    "validate_chrome_trace",
+]

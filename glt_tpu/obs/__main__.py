@@ -1,0 +1,50 @@
+"""CLI: summarize / validate exported traces, dump the metrics snapshot.
+
+    python -m glt_tpu.obs summarize trace.json [--sort self|total|count]
+    python -m glt_tpu.obs validate trace.json
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .summarize import format_summary, load_trace, summarize_trace
+from .trace import validate_chrome_trace
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m glt_tpu.obs",
+        description="glt_tpu observability: trace summary + validation")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_sum = sub.add_parser("summarize",
+                           help="aggregate a Chrome-trace JSON by span")
+    p_sum.add_argument("trace")
+    p_sum.add_argument("--sort", default="total",
+                       choices=("total", "self", "count", "max"),
+                       help="sort column (default: total time)")
+    p_val = sub.add_parser("validate",
+                           help="check Chrome-trace structure + nesting")
+    p_val.add_argument("trace")
+    args = parser.parse_args(argv)
+
+    obj = load_trace(args.trace)
+    if args.cmd == "validate":
+        problems = validate_chrome_trace(obj)
+        for p in problems:
+            print(f"INVALID: {p}")
+        n = len(obj.get("traceEvents", []))
+        if not problems:
+            print(f"OK: {n} events, spans nest, durations non-negative")
+        return 1 if problems else 0
+
+    rows = summarize_trace(obj)
+    key = {"total": "total_ms", "self": "self_ms", "count": "count",
+           "max": "max_ms"}[args.sort]
+    rows.sort(key=lambda r: -r[key])
+    print(format_summary(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,62 @@
+"""Gather roofline: a measured device-memcpy bandwidth ceiling.
+
+The ROADMAP's gather-wall item needs ``gather_gb_s`` expressed as a
+fraction of what the chip can actually stream, not of the datasheet HBM
+number (``est_hbm_fraction`` divides by 819 GB/s — a spec constant this
+host may never reach through the tunnel-dispatched runtime).
+PyTorch-Direct and GIDS (PAPERS.md) both anchor their irregular-gather
+claims the same way: achieved vs a *measured* sequential-copy peak.
+
+Methodology (docs/observability.md "Roofline"):
+
+  * the probe is ``x -> x + 1.0`` over a contiguous f32 buffer under
+    jit: one HBM read + one HBM write per pass = ``2 * nbytes`` traffic,
+    the same in/out streaming a memcpy pays, with no gather indirection;
+  * passes chain (``x = step(x)``) so one host fetch at the end syncs
+    the whole timed region — ``block_until_ready`` does not wait under
+    the axon tunnel (bench.py:33), a host value fetch provably does;
+  * ``memcpy_gb_s = 2 * nbytes * iters / elapsed``; a gather variant's
+    ``roofline_fraction(gather_gb_s, memcpy_gb_s)`` is then the number
+    ROADMAP item 1 names as its success metric (within ~2x of 1.0).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+
+def measure_memcpy_roofline(nbytes: int = 1 << 27, iters: int = 10,
+                            warmup: int = 2) -> Dict[str, float]:
+    """Measure the streaming-copy bandwidth of the default device.
+
+    Returns ``{"memcpy_gb_s", "bytes", "iters", "elapsed_s"}``.  The
+    default 128 MiB buffer is large enough to defeat on-chip caching on
+    any current TPU; shrink ``nbytes`` for CPU smoke runs.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = max(int(nbytes) // 4, 1024)
+    x = jnp.zeros((n,), jnp.float32)
+    step = jax.jit(lambda a: a + 1.0)
+    for _ in range(max(warmup, 1)):
+        x = step(x)
+    float(np.asarray(jax.device_get(x[0])))   # compile + true sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        x = step(x)
+    float(np.asarray(jax.device_get(x[0])))   # host fetch = true sync
+    elapsed = time.perf_counter() - t0
+    moved_gb = 2.0 * n * 4 * iters / 1e9
+    return {
+        "memcpy_gb_s": moved_gb / max(elapsed, 1e-9),
+        "bytes": float(n * 4),
+        "iters": float(iters),
+        "elapsed_s": elapsed,
+    }
+
+
+def roofline_fraction(achieved_gb_s: float, roofline_gb_s: float) -> float:
+    """Achieved bandwidth as a fraction of the measured roofline."""
+    return float(achieved_gb_s) / max(float(roofline_gb_s), 1e-9)

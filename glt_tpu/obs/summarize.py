@@ -1,0 +1,76 @@
+"""Human summary of an exported Chrome trace (`python -m glt_tpu.obs`).
+
+Aggregates complete-events by span name: call count, total/mean/max
+wall, and *self* time (total minus time attributed to nested spans on
+the same thread) — self time is what ranks where a step actually goes.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+
+def summarize_trace(obj: dict) -> List[dict]:
+    """Per-span-name aggregate rows, sorted by total time descending.
+
+    Row keys: ``name, count, total_ms, self_ms, mean_ms, max_ms,
+    device_wait_ms`` (device wait summed over fenced spans only).
+    """
+    events = [e for e in obj.get("traceEvents", []) if e.get("ph") == "X"]
+    by_tid: Dict[tuple, List[dict]] = {}
+    for ev in events:
+        by_tid.setdefault((ev.get("pid"), ev.get("tid")), []).append(ev)
+    stats: Dict[str, dict] = {}
+    eps = 0.5  # us; tolerates rounding at span edges
+    for evs in by_tid.values():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack: List[dict] = []        # {"end", "name"} of open ancestors
+        for ev in evs:
+            while stack and stack[-1]["end"] <= ev["ts"] + eps:
+                stack.pop()
+            if stack:
+                # A child's whole duration leaves its direct parent's
+                # self time (grandchildren subtract from the child).
+                stats[stack[-1]["name"]]["self_us"] -= ev["dur"]
+            stack.append({"end": ev["ts"] + ev["dur"], "name": ev["name"]})
+            r = stats.setdefault(ev["name"], {
+                "name": ev["name"], "count": 0, "total_us": 0.0,
+                "self_us": 0.0, "max_us": 0.0, "device_wait_us": 0.0})
+            r["count"] += 1
+            r["total_us"] += ev["dur"]
+            r["self_us"] += ev["dur"]
+            r["max_us"] = max(r["max_us"], ev["dur"])
+            r["device_wait_us"] += ev.get("args", {}).get(
+                "device_wait_us", 0.0)
+    rows = []
+    for r in sorted(stats.values(), key=lambda r: -r["total_us"]):
+        rows.append({
+            "name": r["name"],
+            "count": r["count"],
+            "total_ms": round(r["total_us"] / 1e3, 3),
+            "self_ms": round(r["self_us"] / 1e3, 3),
+            "mean_ms": round(r["total_us"] / max(r["count"], 1) / 1e3, 3),
+            "max_ms": round(r["max_us"] / 1e3, 3),
+            "device_wait_ms": round(r["device_wait_us"] / 1e3, 3),
+        })
+    return rows
+
+
+def format_summary(rows: List[dict]) -> str:
+    cols = ("name", "count", "total_ms", "self_ms", "mean_ms", "max_ms",
+            "device_wait_ms")
+    widths = {c: (max(len(c), *(len(str(r[c])) for r in rows))
+                  if rows else len(c)) for c in cols}
+    head = "  ".join(c.ljust(widths[c]) for c in cols)
+    sep = "  ".join("-" * widths[c] for c in cols)
+    lines = [head, sep]
+    for r in rows:
+        lines.append("  ".join(
+            str(r[c]).ljust(widths[c]) if c == "name"
+            else str(r[c]).rjust(widths[c]) for c in cols))
+    return "\n".join(lines)
+
+
+def load_trace(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)

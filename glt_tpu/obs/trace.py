@@ -1,0 +1,276 @@
+"""Tracing core: nested host-side spans -> Chrome-trace / Perfetto JSON.
+
+A :class:`Tracer` collects complete-events (``ph: "X"``) from ``with
+span(...)`` blocks; the export loads directly into ``chrome://tracing``
+or https://ui.perfetto.dev, and ``python -m glt_tpu.obs summarize``
+renders a per-span aggregate table.
+
+Two rules make spans safe around jit:
+
+  * **Host-side only.**  Never open a span (or touch a metric) inside a
+    jit-traced function — the call runs once at trace time and vanishes
+    from the compiled program.  gltlint GLT010 ``span-in-traced-code``
+    enforces this statically.
+  * **Explicit device fencing.**  jax dispatch is async, so a span
+    around a jitted call measures *dispatch*, not execution.  Register
+    the call's outputs with ``span.fence(out)`` and the span's close
+    waits for them: ``jax.block_until_ready`` first, then a **host value
+    fetch** — under the axon tunnel ``block_until_ready`` returns before
+    the device finishes (the bench.py:33 caveat; verified there with a
+    matmul chain), and only a host fetch provably waits.  The span then
+    records both the dispatch slice and the device wait in ``args``.
+
+When no tracer is installed, ``span()`` returns a shared no-op object —
+one module-global read per call, cheap enough to leave in hot loops.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# Whole-array host fetches are the provable sync, but fetching a padded
+# frontier or a feature block through the tunnel would distort the span;
+# above this element count only one element is pulled (its value still
+# chains the whole computation).
+_FETCH_MAX_ELEMS = 4096
+
+
+def _device_fence(token_groups: List[Any]) -> None:
+    """Wait until every registered device value is actually computed."""
+    import jax
+    import numpy as np
+
+    leaves: List[Any] = []
+    for tokens in token_groups:
+        leaves.extend(jax.tree_util.tree_leaves(tokens))
+    arrs = [a for a in leaves if isinstance(a, jax.Array)]
+    if not arrs:
+        return
+    jax.block_until_ready(arrs)
+    for a in arrs:
+        if getattr(a, "size", 0) <= _FETCH_MAX_ELEMS:
+            np.asarray(jax.device_get(a))
+        else:
+            np.asarray(jax.device_get(a.ravel()[0]))
+
+
+class Span:
+    """One timed region; use as a context manager (see :func:`span`)."""
+
+    __slots__ = ("_tracer", "name", "_attrs", "_t0_ns", "_tokens", "_depth")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self._attrs = attrs
+        self._tokens: Optional[List[Any]] = None
+
+    def __enter__(self) -> "Span":
+        stack = self._tracer._stack()
+        self._depth = len(stack)
+        stack.append(self)
+        self._t0_ns = time.perf_counter_ns()
+        return self
+
+    def fence(self, tokens):
+        """Register device values to sync before the span closes.
+
+        Returns ``tokens`` unchanged so it drops into assignments:
+        ``loss = sp.fence(loss)``.
+        """
+        if self._tokens is None:
+            self._tokens = []
+        self._tokens.append(tokens)
+        return tokens
+
+    def set(self, **attrs) -> None:
+        """Attach key/value attributes to the span's trace args."""
+        self._attrs.update(attrs)
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dispatch_ns = time.perf_counter_ns() - self._t0_ns
+        if self._tokens is not None and exc_type is None:
+            _device_fence(self._tokens)
+        end_ns = time.perf_counter_ns()
+        stack = self._tracer._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:          # exited out of order; stay consistent
+            stack.remove(self)
+        args = dict(self._attrs)
+        args["depth"] = self._depth
+        if self._tokens is not None:
+            args["dispatch_us"] = round(dispatch_ns / 1e3, 3)
+            args["device_wait_us"] = round(
+                (end_ns - self._t0_ns - dispatch_ns) / 1e3, 3)
+        self._tracer._emit({
+            "name": self.name,
+            "ph": "X",
+            "cat": "glt",
+            "ts": round((self._t0_ns - self._tracer._t0_ns) / 1e3, 3),
+            "dur": round((end_ns - self._t0_ns) / 1e3, 3),
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "args": args,
+        })
+        return False
+
+
+class _NullSpan:
+    """Shared no-op span served while no tracer is installed."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def fence(self, tokens):
+        return tokens
+
+    def set(self, **attrs):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Collects span events; thread-safe (one span stack per thread)."""
+
+    def __init__(self):
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._t0_ns = time.perf_counter_ns()
+
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def span(self, name: str, **attrs) -> Span:
+        return Span(self, name, attrs)
+
+    def _emit(self, event: dict) -> None:
+        with self._lock:
+            self._events.append(event)
+
+    @property
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def chrome_trace(self) -> dict:
+        """The trace as a Chrome-trace-format object (JSON-serializable)."""
+        events = sorted(self.events, key=lambda e: e["ts"])
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export(self, path: str) -> str:
+        """Write the Chrome-trace JSON to ``path``; returns ``path``."""
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+
+# -- global tracer ---------------------------------------------------------
+
+_current: Optional[Tracer] = None
+
+
+def install(tracer: Optional[Tracer]) -> None:
+    """Install ``tracer`` as the process-global span sink (None = off)."""
+    global _current
+    _current = tracer
+
+
+def current() -> Optional[Tracer]:
+    return _current
+
+
+def start_trace() -> Tracer:
+    """Install (and return) a fresh global tracer."""
+    tracer = Tracer()
+    install(tracer)
+    return tracer
+
+
+def stop_trace(path: Optional[str] = None) -> Optional[Tracer]:
+    """Uninstall the global tracer; export to ``path`` if given."""
+    tracer = _current
+    install(None)
+    if tracer is not None and path is not None:
+        tracer.export(path)
+    return tracer
+
+
+def span(name: str, **attrs):
+    """A span on the global tracer — the shared no-op when tracing is off.
+
+    >>> with span("loader.sample_dispatch") as sp:
+    ...     out = sampler.sample_from_nodes(inp)
+    ...     sp.fence(out.num_sampled_edges)   # close waits for the device
+    """
+    tracer = _current
+    if tracer is None:
+        return _NULL_SPAN
+    return Span(tracer, name, attrs)
+
+
+# -- validation ------------------------------------------------------------
+
+def validate_chrome_trace(obj: Any) -> List[str]:
+    """Structural validity problems of a Chrome-trace object ([] = valid).
+
+    Checks the complete-event contract the exporter emits: required keys,
+    non-negative durations/device timings, and — per (pid, tid) — that
+    spans strictly nest (no partial overlap), which is what makes the
+    Perfetto flame view truthful.
+    """
+    problems: List[str] = []
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        return ["top level must be an object with a traceEvents list"]
+    events = obj["traceEvents"]
+    if not isinstance(events, list):
+        return ["traceEvents must be a list"]
+    by_tid: Dict[tuple, List[dict]] = {}
+    for i, ev in enumerate(events):
+        missing = [k for k in ("name", "ph", "ts", "dur", "pid", "tid")
+                   if k not in ev]
+        if missing:
+            problems.append(f"event {i} missing keys {missing}")
+            continue
+        if ev["ph"] != "X":
+            continue
+        if ev["dur"] < 0:
+            problems.append(f"event {i} ({ev['name']}) has negative dur")
+        wait = ev.get("args", {}).get("device_wait_us")
+        if wait is not None and wait < 0:
+            problems.append(
+                f"event {i} ({ev['name']}) has negative device_wait_us")
+        by_tid.setdefault((ev["pid"], ev["tid"]), []).append(ev)
+    eps = 0.5  # us; tolerates equal-microsecond rounding at span edges
+    for (pid, tid), evs in by_tid.items():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack: List[tuple] = []   # (end_ts, name)
+        for ev in evs:
+            end = ev["ts"] + ev["dur"]
+            while stack and stack[-1][0] <= ev["ts"] + eps:
+                stack.pop()
+            if stack and end > stack[-1][0] + eps:
+                problems.append(
+                    f"tid {tid}: span {ev['name']!r} overlaps "
+                    f"{stack[-1][1]!r} without nesting")
+                continue
+            stack.append((end, ev["name"]))
+    return problems

@@ -230,6 +230,16 @@ def autotune_gather_rows(table: jnp.ndarray, idx: jnp.ndarray,
         except Exception:  # pragma: no cover - kernel unsupported on chip
             choice = "xla"
     _AUTO[key] = choice
+    # Autotune runs host-side at warmup (never under trace — GLT010), so
+    # the kernel decision is safe to publish here.
+    from ..obs import metrics as _metrics
+
+    _metrics.counter("glt.gather.autotune_runs",
+                     "gather kernel A/B warmups").inc()
+    _metrics.gauge("glt.gather.pallas_selected",
+                   "1 if the last gather autotune picked the tiled "
+                   "Pallas kernel", labels={"d": str(key[0])},
+                   ).set(1.0 if choice == "pallas" else 0.0)
     return choice
 
 

@@ -31,6 +31,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import metrics as _metrics
+from ..obs.trace import span as _span
 from ..ops.neighbor_sample import _row_offsets_and_degrees, sample_neighbors
 from ..ops.unique import (
     dense_induce,
@@ -43,6 +45,17 @@ from ..ops.unique import (
 from ..sampler.base import NegativeSampling, SamplerOutput
 from ..sampler.neighbor_sampler import hop_widths, max_sampled_nodes
 from ..typing import PADDING_ID
+
+# Host-boundary instrumentation; the shard_map program itself is traced
+# code and stays span-free (gltlint GLT010).
+_M_DIST_BATCHES = _metrics.counter(
+    "glt.dist.sample_batches", "distributed sample programs dispatched")
+_M_DIST_SAMPLE_MS = _metrics.histogram(
+    "glt.dist.sample_dispatch_ms",
+    "dist sampler shard_map dispatch wall per batch")
+_M_ROUTE_AUTOTUNE = _metrics.counter(
+    "glt.dist.route_autotune_runs", "routing A/B warmups",
+)
 
 
 def bounded_remote_cap(width: int, load_factor: float,
@@ -261,6 +274,10 @@ def autotune_routing(b: int, num_shards: int, cap: Optional[int] = None,
         except Exception:  # pragma: no cover - backend quirk: keep fallback
             choice = "sort"
     _ROUTE_AUTO[key] = choice
+    _M_ROUTE_AUTOTUNE.inc()
+    _metrics.gauge("glt.dist.route_onepass_selected",
+                   "1 if the last routing autotune picked one-pass",
+                   ).set(1.0 if choice == "onepass" else 0.0)
     return choice
 
 
@@ -934,8 +951,15 @@ class DistNeighborSampler:
         if key is None:
             key = self._next_key()
         g = self.g
-        return self._shard_fn(g.indptr, g.indices, g.edge_ids,
-                              seeds_per_shard, key)
+        # Host dispatch boundary of the whole shard_map program (routing
+        # + collectives + local sampling run device-side inside it) —
+        # span measures enqueue only, the consumer's sync sees the rest.
+        with _span("dist.sample_dispatch", route=self.route), \
+                _M_DIST_SAMPLE_MS.time():
+            out = self._shard_fn(g.indptr, g.indices, g.edge_ids,
+                                 seeds_per_shard, key)
+        _M_DIST_BATCHES.inc()
+        return out
 
     # -- distributed link path (cf. dist_neighbor_sampler.py:327-453) ------
     def _valid_per_shard(self) -> jnp.ndarray:

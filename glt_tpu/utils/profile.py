@@ -5,6 +5,12 @@ wall-clock + cuda sync).  On TPU the jax profiler is nearly free, so the
 framework wires it in: ``trace()`` wraps a region for Perfetto/XPlane
 capture, and :class:`ThroughputMeter` standardizes the metric definitions
 the benchmarks print (sampled edges/s, feature GB/s, subgraphs/s).
+
+These wrap the *XLA-level* profiler (device kernels, XPlane).  The
+library-level instrument — host-side spans with device fencing, the
+unified metrics namespace, the memcpy roofline — is
+:mod:`glt_tpu.obs` (docs/observability.md); the two compose (an obs
+span around a ``profile.trace`` region labels the XPlane capture).
 """
 from __future__ import annotations
 

@@ -518,6 +518,119 @@ class TestUnboundedBlockingGet:
 
 
 # ---------------------------------------------------------------------------
+# GLT010 span-in-traced-code
+# ---------------------------------------------------------------------------
+
+class TestSpanInTracedCode:
+    def test_positive_span_and_counter_in_jit(self):
+        src = """
+        import jax
+        from glt_tpu.obs.trace import span
+        from glt_tpu.obs import metrics
+
+        _M_STEPS = metrics.counter("glt.x.steps", "steps")
+
+        @jax.jit
+        def step(x):
+            with span("step"):            # vanishes under trace
+                _M_STEPS.inc()            # counts compilations, not calls
+                return x + 1
+        """
+        hits = findings_for(src, "span-in-traced-code")
+        assert len(hits) == 2
+        assert any("span" in h.message for h in hits)
+        assert any(".inc()" in h.message for h in hits)
+
+    def test_positive_chained_factory_in_jit(self):
+        src = """
+        import jax
+        from glt_tpu import obs
+
+        @jax.jit
+        def step(x):
+            obs.metrics.counter("glt.y").inc()
+            return x * 2
+        """
+        # both the factory call and the chained .inc() resolve into obs;
+        # at least one finding must land on the statement
+        assert len(findings_for(src, "span-in-traced-code")) >= 1
+
+    def test_positive_nested_def_inside_jit(self):
+        src = """
+        import jax
+        from glt_tpu.obs.trace import span
+
+        @jax.jit
+        def outer(x):
+            def body(y):
+                with span("inner"):
+                    return y + 1
+            return body(x)
+        """
+        assert len(findings_for(src, "span-in-traced-code")) == 1
+
+    def test_negative_host_loop_instrumentation(self):
+        src = """
+        import jax
+        from glt_tpu.obs.trace import span
+        from glt_tpu.obs import metrics
+
+        _M_STEPS = metrics.counter("glt.x.steps", "steps")
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def epoch(batches):
+            for b in batches:             # host loop: the right boundary
+                with span("step") as sp:
+                    out = step(b)
+                    sp.fence(out)
+                _M_STEPS.inc()
+        """
+        assert findings_for(src, "span-in-traced-code") == []
+
+    def test_negative_at_set_is_not_an_obs_call(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def scatter(x, i):
+            y = x.at[i].set(0.0)          # jnp functional update, not obs
+            c = {}
+            c.update(n=1)
+            return y
+        """
+        assert findings_for(src, "span-in-traced-code") == []
+
+    def test_negative_non_obs_inc_receiver(self):
+        src = """
+        import jax
+
+        @jax.jit
+        def step(counter, x):
+            counter.inc()                 # unknown receiver: not flagged
+            return x
+        """
+        assert findings_for(src, "span-in-traced-code") == []
+
+    def test_suppression_with_justification(self):
+        src = """
+        import jax
+        from glt_tpu.obs.trace import span
+
+        @jax.jit
+        def step(x):
+            # Fixture exercising trace-time-only span (documented).
+            # gltlint: disable-next=span-in-traced-code
+            with span("trace-time-only"):
+                return x + 1
+        """
+        assert findings_for(src, "span-in-traced-code") == []
+
+
+# ---------------------------------------------------------------------------
 # the project engine: symbols, call graph, effects
 # ---------------------------------------------------------------------------
 
@@ -1127,6 +1240,7 @@ def test_rule_registry_complete():
         "int64-id-truncation", "nondeterministic-default-rng",
         "shadowed-jit-donation", "unbounded-blocking-get",
         "lock-order-inversion", "blocking-call-while-holding-lock",
+        "span-in-traced-code",
     }
 
 

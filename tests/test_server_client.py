@@ -47,6 +47,39 @@ def test_dist_context_roles():
         init_worker_group(world_size=2, rank=2)
 
 
+def test_get_metrics_exposition(server):
+    """The observability hook (ISSUE 6): ``get_metrics`` serves the
+    Prometheus text exposition of the unified glt.* namespace, with the
+    live-producer gauge refreshed at scrape time."""
+    from glt_tpu.distributed.dist_client import RemoteServerConnection
+    from glt_tpu.obs import metrics
+
+    metrics.enable()
+    try:
+        conn = RemoteServerConnection(server.addr)
+        loader = RemoteNeighborLoader(server.addr, [2, 2], np.arange(N),
+                                      batch_size=6, prefetch=2)
+        try:
+            for batch in loader:
+                check_batch(batch)
+            resp = conn.request(op="get_metrics")
+            assert resp["enabled"] is True
+            text = resp["text"]
+            assert text == server.metrics_text() or "glt_server" in text
+            assert "# TYPE glt_server_requests_total counter" in text
+            assert 'glt_server_requests_total{op="get_metrics"}' in text
+            assert "glt_server_messages_sent_total" in text
+            assert "# TYPE glt_server_live_producers gauge" in text
+            # the producer we created is live and visible in the gauge
+            assert "glt_server_live_producers 1.0" in text
+            assert "glt_remote_batches_received_total" in text
+        finally:
+            loader.shutdown()
+            conn.close()
+    finally:
+        metrics.disable()
+
+
 def test_remote_loader_epochs(server):
     loader = RemoteNeighborLoader(server.addr, [2, 2], np.arange(N),
                                   batch_size=6, prefetch=2)
