@@ -63,6 +63,19 @@ def _round(v, nd):
     like ``-1.0`` (it's indistinguishable from a measured value)."""
     return None if v is None else round(v, nd)
 
+
+def _emit(out: dict) -> None:
+    """Print the one JSON result line; GLT_BENCH_OUT also writes it to a
+    file so ``scripts/bench_compare.py --fresh`` can judge this run
+    against the committed BENCH_r*.json history without scraping
+    stdout."""
+    line = json.dumps(out)
+    print(line, flush=True)
+    path = os.environ.get("GLT_BENCH_OUT")
+    if path:
+        with open(path, "w") as f:
+            f.write(line + "\n")
+
 # Estimated single-A100 sampled-edges/sec (M) for the reference CUDA engine,
 # fanout [15,10,5] batch 1024 (derivation: BASELINE.md "Baseline anchors").
 BASELINE_A100_M = 600.0
@@ -136,7 +149,7 @@ def _watchdog(deadline_s: float) -> None:
             out.setdefault("unit", "M sampled edges/s")
             out.setdefault("vs_baseline", -1)
             out["partial"] = True
-            print(json.dumps(out), flush=True)
+            _emit(out)
             os._exit(0)
 
     threading.Thread(target=guard, daemon=True,
@@ -863,7 +876,7 @@ def main():
     _DONE = True
     # Unmeasured metrics are None and PRUNED from the line — the JSON
     # omits what this run didn't measure instead of leaking sentinels.
-    print(json.dumps(prune_unmeasured({
+    _emit(prune_unmeasured({
         "metric": "neighbor_sampling_throughput_f15_10_5_b1024",
         "value": round(edges_per_sec_m, 3),
         "unit": "M sampled edges/s",
@@ -976,7 +989,7 @@ def main():
         "obs_noop_ns_per_call": round(obs_noop_ns, 1),
         "serial_step_ms_obs_disabled": round(serial_obs_ms, 2),
         "obs_disabled_overhead_frac": round(obs_overhead_frac, 4),
-    })))
+    }))
 
 
 if __name__ == "__main__":

@@ -33,6 +33,9 @@ from ..channel.base import QueueSourceDied, bounded_get, bounded_put
 from ..channel.serialization import deserialize
 from ..loader.transform import Batch
 from ..obs import metrics as _metrics
+from ..obs import propagate as _prop
+from ..obs.trace import auto_trace, auto_trace_export
+from ..obs.trace import current as _current_tracer
 from ..obs.trace import span as _span
 from .dist_server import (
     _KIND_JSON,
@@ -120,6 +123,9 @@ class RemoteServerConnection:
         self.sock = None
         self._broken = True          # no socket yet
         self.reconnects = 0          # successful re-connections (stats)
+        # Wire context of the epoch in flight (set by the loader): links
+        # request/fetch spans and reconnect/replay events to one trace.
+        self.epoch_ctx: Optional[dict] = None
         self._connect()
 
     # -- connection management --------------------------------------------
@@ -148,6 +154,15 @@ class RemoteServerConnection:
             if getattr(self, "_replacing", False):
                 self.reconnects += 1
                 self._replacing = False
+                tracer = _current_tracer()
+                if tracer is not None:
+                    # Tagged with the originating epoch's trace id so a
+                    # merged trace attributes reconnect storms to the
+                    # batch stream that suffered them.
+                    ctx = self.epoch_ctx or {}
+                    tracer.instant("remote.reconnect",
+                                   trace_id=ctx.get("tid"),
+                                   addr=list(self._addrs[i]))
             self.sock = sock
             self._addr_i = i
             self._broken = False
@@ -198,9 +213,16 @@ class RemoteServerConnection:
                         # framed stream desynced; reconnecting is the only
                         # way to resync it.
                         self._connect()
+                    # NTP sample half: t0 just before send, t3 just after
+                    # a complete receive, both in the trace clock (only
+                    # stamped while tracing — zero timestamp calls when
+                    # off).
+                    tracer = _current_tracer()
+                    t0 = tracer.now_us() if tracer is not None else None
                     send_frame(self.sock, _KIND_JSON, payload)
                     kind, data = recv_frame(
                         self.sock, max_len=self.max_frame_bytes)
+                    t3 = tracer.now_us() if tracer is not None else None
                     if kind is None:
                         # EOF (clean or mid-frame) — the server closed the
                         # socket (died, or dropped us after an error).
@@ -213,7 +235,7 @@ class RemoteServerConnection:
                             # us and is closing: retryable — a fresh
                             # connection resyncs the framing.
                             raise ProtocolError(resp.get("error", ""))
-                    return kind, data
+                    return kind, data, t0, t3
                 except self.RETRYABLE as e:
                     self._broken = True
                     last_exc = e
@@ -229,15 +251,29 @@ class RemoteServerConnection:
 
     # -- protocol ----------------------------------------------------------
     def request(self, _stop: Optional[threading.Event] = None,
-                _retries: Optional[int] = None, **req) -> dict:
-        kind, data = self._exchange(json.dumps(req).encode(),
-                                    stop=_stop, retries=_retries)
-        if kind != _KIND_JSON:
-            raise RuntimeError("expected JSON response")
-        resp = json.loads(data)
-        if "error" in resp:
-            self._raise_structured(resp)
-        return resp
+                _retries: Optional[int] = None,
+                _trace_ctx: Optional[dict] = None, **req) -> dict:
+        with _span("remote.request", op=str(req.get("op"))) as sp:
+            if self.epoch_ctx:
+                sp.link(self.epoch_ctx.get("tid"),
+                        self.epoch_ctx.get("sid"))
+            if _trace_ctx is not None:
+                # Explicit remote parent (the loader passes the EPOCH
+                # span for start_new_epoch_sampling: producer spans live
+                # far longer than this request's round trip, so they
+                # must hang off the epoch, not off this request span).
+                req[_prop.WIRE_KEY] = _trace_ctx
+            else:
+                _prop.inject(req, sp)
+            kind, data, t0, t3 = self._exchange(
+                json.dumps(req).encode(), stop=_stop, retries=_retries)
+            if kind != _KIND_JSON:
+                raise RuntimeError("expected JSON response")
+            resp = json.loads(data)
+            _prop.record_clock_sync(resp.pop(_prop.WIRE_KEY, None), t0, t3)
+            if "error" in resp:
+                self._raise_structured(resp)
+            return resp
 
     def fetch_message(self, producer_id: int, epoch: int = 0,
                       ack: int = -1,
@@ -247,17 +283,34 @@ class RemoteServerConnection:
         ``ack`` (highest seq contiguously received) releases the server's
         replay window and directs resume after a reconnect.
         """
-        kind, data = self._exchange(json.dumps(
-            {"op": "fetch_one_sampled_message",
-             "producer_id": producer_id,
-             "epoch": epoch, "ack": ack}).encode(), stop=stop)
-        if kind != _KIND_MSG:
-            resp = json.loads(data)
-            if "error" in resp:
-                self._raise_structured(resp)
-            raise RuntimeError("bad frame")
-        seq = struct.unpack_from("<Q", data, 0)[0]
-        return int(seq), deserialize(memoryview(data)[8:])
+        with _span("remote.fetch", epoch=epoch) as sp:
+            if self.epoch_ctx:
+                sp.link(self.epoch_ctx.get("tid"),
+                        self.epoch_ctx.get("sid"))
+            req = {"op": "fetch_one_sampled_message",
+                   "producer_id": producer_id,
+                   "epoch": epoch, "ack": ack}
+            _prop.inject(req, sp)
+            kind, data, t0, t3 = self._exchange(
+                json.dumps(req).encode(), stop=stop)
+            if kind != _KIND_MSG:
+                resp = json.loads(data)
+                if "error" in resp:
+                    self._raise_structured(resp)
+                raise RuntimeError("bad frame")
+            # A traced server appends an append-only trailer (clock echo)
+            # AFTER the payload — but only when THIS request carried the
+            # trace context (negotiation).  Only look for it then, so an
+            # untraced exchange can never misread payload bytes that
+            # happen to end in the magic.
+            if _prop.WIRE_KEY in req:
+                payload, echo = _prop.split_trailer(data)
+                _prop.record_clock_sync(echo, t0, t3)
+            else:
+                payload = memoryview(data)
+            seq = struct.unpack_from("<Q", payload, 0)[0]
+            sp.set(seq=int(seq))
+            return int(seq), deserialize(payload[8:])
 
     @property
     def broken(self) -> bool:
@@ -346,6 +399,9 @@ class RemoteNeighborLoader:
         self.prefetch = max(1, int(opts.prefetch_size))
         self._epoch = 0
         self.epoch_stats: dict = {}
+        # GLT_OBS_TRACE_DIR: per-process trace file exported at shutdown
+        # (one track per fleet process; stitch with `obs merge`).
+        self._trace_export_path = auto_trace("client")
 
     def __len__(self) -> int:
         return self.num_expected
@@ -353,8 +409,18 @@ class RemoteNeighborLoader:
     def __iter__(self) -> Iterator[Batch]:
         self._epoch += 1
         epoch = self._epoch
+        with _span("remote.epoch", epoch=epoch) as ep_span:
+            yield from self._iter_epoch(epoch, ep_span)
+
+    def _iter_epoch(self, epoch: int, ep_span) -> Iterator[Batch]:
+        # The epoch span is the trace ROOT: every request/fetch span
+        # (this process), server stage span, and producer/worker span of
+        # this epoch joins its trace id — one causally-linked tree per
+        # remote-sampling run once `obs merge` aligns the clocks.
+        self.conn.epoch_ctx = ep_span.context()
         self.conn.request(op="start_new_epoch_sampling",
-                          producer_id=self.producer_id, epoch=epoch)
+                          producer_id=self.producer_id, epoch=epoch,
+                          _trace_ctx=self.conn.epoch_ctx)
         # Bounded to the configured prefetch depth: a slow trainer holds at
         # most ``prefetch`` unconsumed messages instead of buffering the
         # whole epoch in client RAM (the reference's prefetch_size
@@ -403,19 +469,18 @@ class RemoteNeighborLoader:
         t = threading.Thread(target=prefetcher, daemon=True)
         t.start()
         try:
-            with _span("remote.epoch", epoch=epoch):
-                for _ in range(self.num_expected):
-                    try:
-                        item = bounded_get(buf, alive=t.is_alive, poll=0.2)
-                    except QueueSourceDied:
-                        raise RuntimeError(
-                            "remote sampling prefetch thread died "
-                            "unexpectedly") from None
-                    if isinstance(item, Exception):
-                        raise RuntimeError(
-                            f"remote sampling prefetch failed: {item}"
-                        ) from item
-                    yield message_to_batch(item)
+            for _ in range(self.num_expected):
+                try:
+                    item = bounded_get(buf, alive=t.is_alive, poll=0.2)
+                except QueueSourceDied:
+                    raise RuntimeError(
+                        "remote sampling prefetch thread died "
+                        "unexpectedly") from None
+                if isinstance(item, Exception):
+                    raise RuntimeError(
+                        f"remote sampling prefetch failed: {item}"
+                    ) from item
+                yield message_to_batch(item)
         finally:
             stop.set()
             # Join the prefetcher: one still blocked inside fetch_message
@@ -431,6 +496,7 @@ class RemoteNeighborLoader:
             stats["reconnects"] = self.conn.reconnects - reconnects_before
             # Back-compat alias; the metrics registry is the unified view.
             self.epoch_stats = publish_epoch_stats(stats)
+            self.conn.epoch_ctx = None
 
     def shutdown(self, exit_server: bool = False) -> None:
         try:
@@ -442,3 +508,4 @@ class RemoteNeighborLoader:
             pass   # unreachable server: the lease reaper collects it
         finally:
             self.conn.close()
+            auto_trace_export(self._trace_export_path)

@@ -21,6 +21,10 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from ..channel import ShmChannel
+from ..obs import propagate as _prop
+from ..obs.trace import auto_trace, auto_trace_export
+from ..obs.trace import current as _current_tracer
+from ..obs.trace import span as _span
 from .dist_options import MpSamplingWorkerOptions
 from .sample_message import batch_to_message
 
@@ -28,6 +32,12 @@ _CMD_SAMPLE_EPOCH = 0
 _CMD_STOP = 1
 
 _WORKER_KEY = "#worker"
+# Worker clock stamp riding each message: [worker pid, send time in the
+# worker's trace clock (us)].  Popped by the consumer (_account) and
+# turned into an ``obs.clock_oneway`` sync sample — the shm ring has no
+# response path, so this one-way direction is what aligns worker clocks
+# in `obs merge`.  Only attached while the worker traces.
+_OBS_KEY = "#obs"
 
 # Sampler-construction kwargs the worker loop honors for the node kind;
 # dist_loader validates mp-mode kwargs against this same set.
@@ -108,26 +118,41 @@ def _sampling_worker_loop(worker_id, dataset_builder, builder_args,
                                     max_degree=kk["max_degree"])
         raise ValueError(f"unknown sampling kind {kind!r}")
 
+    # GLT_OBS_TRACE_DIR: the worker writes its own per-process trace
+    # file, exported when the parent sends _CMD_STOP.
+    trace_path = auto_trace(f"worker{worker_id}")
+
     while True:
         # Idle worker awaiting commands: there is no liveness to probe
         # from here (the parent owns it), and shutdown() sends _CMD_STOP
         # then terminates stragglers — the wait is bounded by the parent.
         # gltlint: disable-next=unbounded-blocking-get
-        cmd, payload = task_queue.get()
+        task = task_queue.get()
+        cmd, payload, meta = (task if len(task) == 3
+                              else (task[0], task[1], None))
         if cmd == _CMD_STOP:
+            auto_trace_export(trace_path)
             break
+        ctx = meta or {}
         n = chunk_len(payload)
         for lo in range(0, n, batch_size):
             hi = min(lo + batch_size, n)
-            out = sample(payload, lo, hi)
-            batch = collate_loader._collate_fn(out, hi - lo)
-            if kind == "hetero_node":
-                msg = hetero_batch_to_message(batch)
-            else:
-                msg = batch_to_message(batch)
+            with _span("worker.sample_batch", worker=worker_id,
+                       lo=lo) as sp:
+                sp.link(ctx.get("tid"), ctx.get("sid"))
+                out = sample(payload, lo, hi)
+                batch = collate_loader._collate_fn(out, hi - lo)
+                if kind == "hetero_node":
+                    msg = hetero_batch_to_message(batch)
+                else:
+                    msg = batch_to_message(batch)
             # Provenance tag so the trainer can attribute delivered batches
             # per worker and reissue a dead worker's unfinished seed range.
             msg[_WORKER_KEY] = np.array([worker_id], np.int64)
+            tracer = _current_tracer()
+            if tracer is not None:
+                msg[_OBS_KEY] = np.array(
+                    [float(os.getpid()), tracer.now_us()], np.float64)
             channel.send(msg)
 
 
@@ -176,6 +201,7 @@ class MpSamplingProducer:
         self._chunks = []
         self._delivered = []
         self._builder = (dataset_builder, builder_args, list(num_neighbors))
+        self._epoch_trace_ctx: Optional[dict] = None
         self.max_respawns = 3
         # Cooperative stop for consumers blocked in iter_messages (e.g. a
         # server forwarder thread): set before shutdown() so the iterator
@@ -226,10 +252,15 @@ class MpSamplingProducer:
             return (self._link_eli[:, chunk], lab)
         return chunk
 
-    def produce_all(self) -> None:
+    def produce_all(self, trace_ctx: Optional[dict] = None) -> None:
         """Kick one epoch: split seeds batch-aligned across workers
-        (cf. dist_sampling_producer.py:229-247)."""
+        (cf. dist_sampling_producer.py:229-247).
+
+        ``trace_ctx`` (the epoch's wire trace context) rides the task
+        payload so worker-side sampling spans join the epoch's trace.
+        """
         self._ensure_alive()
+        self._epoch_trace_ctx = trace_ctx
         ids = self.input_nodes
         if self.shuffle:
             ids = ids[self._rng.permutation(ids.shape[0])]
@@ -243,7 +274,8 @@ class MpSamplingProducer:
             self._chunks.append(chunk)
             self._delivered.append(0)
             if chunk.shape[0] > 0:
-                tq.put((_CMD_SAMPLE_EPOCH, self._payload(chunk)))
+                tq.put((_CMD_SAMPLE_EPOCH, self._payload(chunk),
+                        trace_ctx))
 
     def iter_messages(self):
         """Yield every message of the current epoch, surviving mid-epoch
@@ -300,18 +332,25 @@ class MpSamplingProducer:
                 self._delivered[w] = 0
                 if rest.shape[0] > 0:
                     self._task_queues[w].put(
-                        (_CMD_SAMPLE_EPOCH, self._payload(rest)))
+                        (_CMD_SAMPLE_EPOCH, self._payload(rest),
+                         self._epoch_trace_ctx))
 
     def _account(self, msg) -> None:
         tag = msg.pop(_WORKER_KEY, None)
         if tag is not None:
             self._delivered[int(np.asarray(tag).ravel()[0])] += 1
+        stamp = msg.pop(_OBS_KEY, None)
+        if stamp is not None:
+            arr = np.asarray(stamp).ravel()
+            if arr.shape[0] >= 2:
+                _prop.record_clock_oneway(int(arr[0]), "worker",
+                                          float(arr[1]))
 
     def shutdown(self) -> None:
         self._stopping.set()
         for tq in self._task_queues:
             try:
-                tq.put((_CMD_STOP, None))
+                tq.put((_CMD_STOP, None, None))
             except Exception:
                 pass
         for p in self._workers:

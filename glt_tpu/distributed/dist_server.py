@@ -45,6 +45,10 @@ import numpy as np
 from ..channel.base import QueueSourceDied, bounded_get, bounded_put
 from ..channel.serialization import deserialize, serialize
 from ..obs import metrics as _metrics
+from ..obs import propagate as _prop
+from ..obs.trace import auto_trace, auto_trace_export
+from ..obs.trace import current as _current_tracer
+from ..obs.trace import span as _span
 from ..testing.faults import FaultPlan, ProducerKilled
 
 # Server metrics (docs/observability.md "glt.server.*"): the production
@@ -61,6 +65,24 @@ _M_CREATED = _metrics.counter(
     "glt.server.producers_created", "sampling producers created")
 _M_ERRORS = _metrics.counter(
     "glt.server.request_errors", "structured per-request failures")
+
+# Per-request latency decomposition (docs/observability.md "Server-side
+# latency decomposition"): where a fetch's wall time goes, server-side.
+# snapshot() derives p50/p95/p99 per stage — the SLO groundwork the
+# serving path (ROADMAP item 3) reads.
+_H_QUEUE_WAIT = _metrics.histogram(
+    "glt.server.queue_wait_ms",
+    "fetch blocked waiting for the producer buffer (queue wait)")
+_H_SAMPLE = _metrics.histogram(
+    "glt.server.sample_ms", "producer-side sampling wall per batch")
+_H_SERIALIZE = _metrics.histogram(
+    "glt.server.serialize_ms",
+    "batch flatten+serialize wall per message")
+_H_SEND = _metrics.histogram(
+    "glt.server.send_ms", "sampled-frame socket send wall")
+_H_REPLAY = _metrics.histogram(
+    "glt.server.replay_ms",
+    "replay-served fetches: window lookup + resend wall")
 
 _KIND_JSON = 0
 _KIND_MSG = 1
@@ -160,6 +182,9 @@ class _Producer:
         self._retained: Deque[Tuple[int, bytes]] = collections.deque()
         self._orphans: list = []
         self._error: Optional[Exception] = None
+        # Wire context of the epoch's start request: producer spans (and
+        # the mp workers, via the task payload) join this trace.
+        self._trace_ctx: Optional[dict] = None
         if num_workers > 0:
             if dataset_builder is None:
                 raise ValueError(
@@ -199,7 +224,8 @@ class _Producer:
         return (self.lease_secs > 0
                 and now - self.last_active > self.lease_secs)
 
-    def start_epoch(self, epoch: int = 0) -> None:
+    def start_epoch(self, epoch: int = 0,
+                    trace_ctx: Optional[dict] = None) -> None:
         if self._thread is not None:
             # Tell the previous epoch's thread to stop before joining: a
             # client that abandoned its epoch mid-way (early stopping)
@@ -226,8 +252,9 @@ class _Producer:
             self._retained.clear()
             self._orphans.clear()
             self._error = None
+            self._trace_ctx = trace_ctx
         if self._mp_producer is not None:
-            self._mp_producer.produce_all()
+            self._mp_producer.produce_all(trace_ctx=trace_ctx)
             self._thread = threading.Thread(target=self._forward_mp,
                                             args=(int(epoch),), daemon=True)
         else:
@@ -238,16 +265,27 @@ class _Producer:
     def _run(self, epoch: int) -> None:
         from .sample_message import batch_to_message
 
+        ctx = self._trace_ctx or {}
         # Loader failures are relayed to the fetching client (same
         # contract as _forward_mp) instead of dying silently here.
         try:
-            for batch in self.loader:
+            batches = iter(self.loader)
+            for i in range(self._num_expected):
+                with _span("producer.sample_batch", epoch=epoch,
+                           index=i) as sp:
+                    sp.link(ctx.get("tid"), ctx.get("sid"))
+                    t0 = time.perf_counter()
+                    try:
+                        batch = next(batches)
+                    except StopIteration:
+                        break
+                    _H_SAMPLE.observe((time.perf_counter() - t0) * 1e3)
+                    with _H_SERIALIZE.time():
+                        payload = serialize(batch_to_message(batch))
                 # stop-aware put so a producer whose client vanished
                 # mid-epoch exits instead of wedging on the bounded buffer
                 # (and permanently poisoning this producer id).
-                if not bounded_put(self.buffer,
-                                   (epoch,
-                                    serialize(batch_to_message(batch))),
+                if not bounded_put(self.buffer, (epoch, payload),
                                    self._stop):
                     return
                 if self._fault_plan is not None:
@@ -266,7 +304,9 @@ class _Producer:
         # it in this daemon thread (which would hang the client forever).
         try:
             for msg in self._mp_producer.iter_messages():
-                if not bounded_put(self.buffer, (epoch, serialize(msg)),
+                with _H_SERIALIZE.time():
+                    payload = serialize(msg)
+                if not bounded_put(self.buffer, (epoch, payload),
                                    self._stop):
                     return
         except Exception as e:  # noqa: BLE001 — relayed to client
@@ -288,10 +328,13 @@ class _Producer:
         """Pop the next item produced *for this epoch*: orphans first
         (items a dead connection's reader popped but could not deliver),
         then the buffer; items left over from an older epoch are dropped."""
+        t_wait0 = time.perf_counter()
         while True:
             with self._seq_lock:
                 self._check_epoch(epoch)
                 if self._orphans:
+                    _H_QUEUE_WAIT.observe(
+                        (time.perf_counter() - t_wait0) * 1e3)
                     return self._orphans.pop(0)
             # Bounded wait with a liveness recheck (the GLT007 hang class):
             # if the epoch thread died between its last put and our get,
@@ -309,17 +352,18 @@ class _Producer:
                     # were blocked.  Re-home the item for the live epoch.
                     self._orphans.append(item)
                     self._check_epoch(epoch)
+            _H_QUEUE_WAIT.observe((time.perf_counter() - t_wait0) * 1e3)
             return item
 
-    def fetch_next(self, ack: int, epoch: int) -> Tuple[int, bytes]:
-        """Return ``(seq, payload)`` — the resumable fetch.
+    def fetch_next(self, ack: int, epoch: int) -> Tuple[int, bytes, bool]:
+        """Return ``(seq, payload, replayed)`` — the resumable fetch.
 
         ``ack`` is the highest seq the client has contiguously received:
         everything at or below it is released from the replay window; the
         oldest retained seq above it (a message lost in flight on a dead
-        connection) is re-sent before anything fresh is produced, so every
-        batch of an epoch is delivered exactly once across arbitrarily
-        many reconnects.
+        connection) is re-sent before anything fresh is produced
+        (``replayed=True``), so every batch of an epoch is delivered
+        exactly once across arbitrarily many reconnects.
         """
         self.touch()
         with self._seq_lock:
@@ -336,7 +380,12 @@ class _Producer:
         if resend is not None:
             # Sent but never received: resume from the oldest gap.
             _M_REPLAYS.inc()
-            return resend
+            tracer = _current_tracer()
+            if tracer is not None:
+                ctx = self._trace_ctx or {}
+                tracer.instant("server.replay", seq=resend[0],
+                               epoch=epoch, trace_id=ctx.get("tid"))
+            return resend[0], resend[1], True
         try:
             item = self._pop_current(epoch)
         except QueueSourceDied:
@@ -358,7 +407,7 @@ class _Producer:
             self._retained.append((seq, item))
             while len(self._retained) > self.replay_window:
                 self._retained.popleft()
-        return seq, item
+        return seq, item, False
 
     def stop(self) -> None:
         self._stop.set()
@@ -395,6 +444,10 @@ class DistServer:
             # Serving deployments opt in: flips the PROCESS-wide metrics
             # switch so the get_metrics exposition carries live counters.
             _metrics.enable()
+        # GLT_OBS_TRACE_DIR: this process exports its own trace file at
+        # shutdown; `python -m glt_tpu.obs merge` stitches it with the
+        # client's and the workers' into one fleet trace.
+        self._trace_export_path = auto_trace("server")
 
         self.dataset = dataset
         self._dataset_builder = dataset_builder
@@ -478,7 +531,7 @@ class DistServer:
         return _metrics.render_prometheus()
 
     # -- request handlers (cf. _call_func_on_server, dist_server.py:214) ---
-    def _handle(self, req: dict):
+    def _handle(self, req: dict, trace_ctx: Optional[dict] = None):
         op = req["op"]
         if op == "get_dataset_meta":
             g = self.dataset.get_graph()
@@ -530,7 +583,8 @@ class DistServer:
             return {"text": self.metrics_text(),
                     "enabled": _metrics.enabled()}
         if op == "start_new_epoch_sampling":
-            self._get_producer(req).start_epoch(int(req.get("epoch", 0)))
+            self._get_producer(req).start_epoch(
+                int(req.get("epoch", 0)), trace_ctx=trace_ctx)
             return {"ok": True}
         if op == "destroy_sampling_producer":
             with self._lock:
@@ -566,23 +620,53 @@ class DistServer:
                 kind, data = recv_frame(conn, max_len=self.max_frame_bytes)
                 if kind is None:
                     return
+                tracer = _current_tracer()
+                t_recv_us = tracer.now_us() if tracer is not None else None
                 req = json.loads(data)
+                # Trace context rides a reserved JSON key — a pre-trace
+                # server reads only the keys it knows, so old/new peers
+                # interoperate (mixed-version test); popped here so
+                # request handlers never see it.
+                ctx = _prop.extract(req)
                 _metrics.counter(
                     "glt.server.requests", "requests handled, by op",
                     labels={"op": str(req.get("op"))}).inc()
                 try:
                     if req["op"] == "fetch_one_sampled_message":
-                        prod = self._get_producer(req)
-                        seq, payload = prod.fetch_next(
-                            int(req.get("ack", -1)),
-                            int(req.get("epoch", 0)))
-                        send_frame(conn, _KIND_MSG,
-                                   struct.pack("<Q", seq) + payload)
+                        t_req0 = time.perf_counter()
+                        with _span("server.fetch") as sp:
+                            if ctx:
+                                sp.link(ctx.get("tid"), ctx.get("sid"))
+                            prod = self._get_producer(req)
+                            seq, payload, replayed = prod.fetch_next(
+                                int(req.get("ack", -1)),
+                                int(req.get("epoch", 0)))
+                            sp.set(seq=seq, replayed=replayed)
+                            frame = struct.pack("<Q", seq) + payload
+                            if ctx and tracer is not None:
+                                # Clock echo as an append-only trailer —
+                                # only on negotiated (context-carrying)
+                                # requests, so an old client never sees
+                                # trailer bytes.
+                                frame = _prop.pack_trailer(
+                                    frame, _prop.server_echo(
+                                        tracer, t_recv_us))
+                            with _H_SEND.time():
+                                send_frame(conn, _KIND_MSG, frame)
+                        if replayed:
+                            _H_REPLAY.observe(
+                                (time.perf_counter() - t_req0) * 1e3)
                         _M_MESSAGES.inc()
                     else:
-                        resp = self._handle(req)
-                        send_frame(conn, _KIND_JSON,
-                                   json.dumps(resp).encode())
+                        with _span("server." + str(req["op"])) as sp:
+                            if ctx:
+                                sp.link(ctx.get("tid"), ctx.get("sid"))
+                            resp = self._handle(req, trace_ctx=ctx)
+                            if ctx and tracer is not None:
+                                resp[_prop.WIRE_KEY] = _prop.server_echo(
+                                    tracer, t_recv_us)
+                            send_frame(conn, _KIND_JSON,
+                                       json.dumps(resp).encode())
                 except RequestError as e:
                     # Structured per-request failure: report it and keep
                     # the connection serving — the framed stream is still
@@ -621,6 +705,7 @@ class DistServer:
             self._sock.close()
         except OSError:
             pass
+        auto_trace_export(self._trace_export_path)
 
 
 def init_server(dataset, host: str = "127.0.0.1", port: int = 0,

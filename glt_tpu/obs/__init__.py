@@ -25,12 +25,15 @@ never call span()/inc() inside a jit-traced function (gltlint GLT010).
 >>> obs.metrics.snapshot()["glt.loader.batches"]
 """
 from . import metrics  # noqa: F401  (stdlib-only; safe without jax)
+from .merge import merge_traces, span_tree_check  # noqa: F401
 from .metrics import prune_unmeasured  # noqa: F401
 from .roofline import measure_memcpy_roofline, roofline_fraction  # noqa: F401
 from .summarize import format_summary, summarize_trace  # noqa: F401
 from .trace import (  # noqa: F401
     Span,
     Tracer,
+    auto_trace,
+    auto_trace_export,
     current,
     install,
     span,
@@ -42,14 +45,18 @@ from .trace import (  # noqa: F401
 __all__ = [
     "Span",
     "Tracer",
+    "auto_trace",
+    "auto_trace_export",
     "current",
     "format_summary",
     "install",
     "measure_memcpy_roofline",
+    "merge_traces",
     "metrics",
     "prune_unmeasured",
     "roofline_fraction",
     "span",
+    "span_tree_check",
     "start_trace",
     "stop_trace",
     "summarize_trace",

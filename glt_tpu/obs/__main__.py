@@ -1,13 +1,17 @@
-"""CLI: summarize / validate exported traces, dump the metrics snapshot.
+"""CLI: summarize / validate / merge exported traces.
 
     python -m glt_tpu.obs summarize trace.json [--sort self|total|count]
+                                               [--json]
     python -m glt_tpu.obs validate trace.json
+    python -m glt_tpu.obs merge -o merged.json client.json server.json ...
 """
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
+from .merge import merge_traces
 from .summarize import format_summary, load_trace, summarize_trace
 from .trace import validate_chrome_trace
 
@@ -15,7 +19,8 @@ from .trace import validate_chrome_trace
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m glt_tpu.obs",
-        description="glt_tpu observability: trace summary + validation")
+        description="glt_tpu observability: trace summary, validation, "
+                    "and cross-process merge")
     sub = parser.add_subparsers(dest="cmd", required=True)
     p_sum = sub.add_parser("summarize",
                            help="aggregate a Chrome-trace JSON by span")
@@ -23,10 +28,43 @@ def main(argv=None) -> int:
     p_sum.add_argument("--sort", default="total",
                        choices=("total", "self", "count", "max"),
                        help="sort column (default: total time)")
+    p_sum.add_argument("--json", action="store_true",
+                       help="emit the aggregate rows as a JSON list "
+                            "(machine-readable; no screen-scraping)")
     p_val = sub.add_parser("validate",
                            help="check Chrome-trace structure + nesting")
     p_val.add_argument("trace")
+    p_merge = sub.add_parser(
+        "merge",
+        help="stitch per-process trace files into one clock-aligned "
+             "Chrome trace (NTP-style offsets from obs.clock_sync "
+             "samples; see docs/observability.md)")
+    p_merge.add_argument("traces", nargs="+",
+                         help="per-process trace files (client, server, "
+                              "workers)")
+    p_merge.add_argument("-o", "--out", required=True,
+                         help="merged output path")
+    p_merge.add_argument("--ref-pid", type=int, default=None,
+                         help="process whose clock is the reference "
+                              "(default: the one with most sync samples)")
     args = parser.parse_args(argv)
+
+    if args.cmd == "merge":
+        merged = merge_traces(args.traces, out=args.out,
+                              ref_pid=args.ref_pid)
+        info = merged["glt"]
+        for pid, off in sorted(info["clock_offsets_us"].items()):
+            print(f"pid {pid}: offset {off:+.1f} us")
+        if info["unaligned_pids"]:
+            print(f"WARNING: no sync path for pids "
+                  f"{info['unaligned_pids']} (kept unshifted)")
+        problems = validate_chrome_trace(merged)
+        for p in problems:
+            print(f"INVALID: {p}")
+        print(f"{'INVALID' if problems else 'OK'}: merged "
+              f"{len(args.traces)} files, "
+              f"{len(merged['traceEvents'])} events -> {args.out}")
+        return 1 if problems else 0
 
     obj = load_trace(args.trace)
     if args.cmd == "validate":
@@ -42,7 +80,10 @@ def main(argv=None) -> int:
     key = {"total": "total_ms", "self": "self_ms", "count": "count",
            "max": "max_ms"}[args.sort]
     rows.sort(key=lambda r: -r[key])
-    print(format_summary(rows))
+    if args.json:
+        print(json.dumps(rows))
+    else:
+        print(format_summary(rows))
     return 0
 
 

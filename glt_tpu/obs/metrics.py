@@ -202,6 +202,33 @@ class Histogram(_Instrument):
     def sum(self) -> float:
         return self._sum
 
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (0 <= q <= 1) by linear interpolation
+        within the cumulative buckets (Prometheus
+        ``histogram_quantile`` semantics).
+
+        The estimate lands inside the bucket containing the target rank,
+        interpolated between the bucket's bounds (lower bound 0 for the
+        first bucket); ranks in the +Inf tail return the highest finite
+        bucket edge.  NaN on an empty histogram.
+        """
+        q = min(max(float(q), 0.0), 1.0)
+        with self._lock:
+            count = self._count
+            counts = list(self._counts)
+        if count == 0:
+            return float("nan")
+        target = q * count
+        cum = 0.0
+        for i, c in enumerate(counts[:-1]):
+            prev = cum
+            cum += c
+            if cum >= target and c > 0:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                return lo + (hi - lo) * (target - prev) / c
+        return self.buckets[-1]
+
 
 class Registry:
     """Process-global instrument table, keyed by ``(kind, name, labels)``."""
@@ -256,12 +283,19 @@ class Registry:
 
     def snapshot(self) -> Dict[str, float]:
         """Flat ``{name[{labels}]: value}`` view; histograms contribute
-        ``<name>.count`` and ``<name>.sum``."""
+        ``<name>.count`` and ``<name>.sum`` plus derived
+        ``.p50/.p95/.p99`` latency quantiles once they hold samples —
+        the SLO read ``bench_serving``-class consumers want without
+        re-deriving from buckets."""
         out: Dict[str, float] = {}
         for inst in self.instruments():
             if isinstance(inst, Histogram):
                 out[inst.full_name + ".count"] = float(inst.count)
                 out[inst.full_name + ".sum"] = float(inst.sum)
+                if inst.count:
+                    out[inst.full_name + ".p50"] = inst.quantile(0.50)
+                    out[inst.full_name + ".p95"] = inst.quantile(0.95)
+                    out[inst.full_name + ".p99"] = inst.quantile(0.99)
             else:
                 out[inst.full_name] = float(inst.value)
         return out

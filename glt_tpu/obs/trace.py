@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import struct
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -57,23 +58,63 @@ def _device_fence(token_groups: List[Any]) -> None:
             np.asarray(jax.device_get(a.ravel()[0]))
 
 
+def _gen_trace_id() -> str:
+    """A fresh 64-bit trace id (hex) — unique across processes."""
+    return os.urandom(8).hex()
+
+
 class Span:
     """One timed region; use as a context manager (see :func:`span`)."""
 
-    __slots__ = ("_tracer", "name", "_attrs", "_t0_ns", "_tokens", "_depth")
+    __slots__ = ("_tracer", "name", "_attrs", "_t0_ns", "_tokens", "_depth",
+                 "span_id", "trace_id", "_parent_id")
 
     def __init__(self, tracer: "Tracer", name: str, attrs: Dict[str, Any]):
         self._tracer = tracer
         self.name = name
         self._attrs = attrs
         self._tokens: Optional[List[Any]] = None
+        self.span_id: Optional[int] = None
+        self.trace_id: Optional[str] = None
+        self._parent_id: Optional[int] = None
 
     def __enter__(self) -> "Span":
         stack = self._tracer._stack()
         self._depth = len(stack)
+        self.span_id = self._tracer._next_span_id()
+        if stack:
+            parent = stack[-1]
+            self._parent_id = parent.span_id
+            if self.trace_id is None:
+                self.trace_id = parent.trace_id
         stack.append(self)
         self._t0_ns = time.perf_counter_ns()
         return self
+
+    def link(self, trace_id: Optional[str],
+             parent_span_id: Optional[int]) -> "Span":
+        """Adopt a REMOTE parent (cross-process trace propagation).
+
+        The span joins trace ``trace_id`` as a child of the peer's
+        ``parent_span_id`` — ``python -m glt_tpu.obs merge`` uses these
+        links to stitch per-process trace files into one causally
+        connected tree.  Returns ``self`` for chaining.
+        """
+        if trace_id:
+            self.trace_id = str(trace_id)
+        if parent_span_id is not None:
+            self._parent_id = int(parent_span_id)
+        return self
+
+    def context(self) -> Dict[str, Any]:
+        """Wire context for propagating this span to another process:
+        ``{"tid": trace id, "sid": this span's id, "ts": send time in
+        this process's trace clock (us)}``.  Call inside the ``with``
+        block; generates a fresh trace id for a root span."""
+        if self.trace_id is None:
+            self.trace_id = _gen_trace_id()
+        return {"tid": self.trace_id, "sid": self.span_id,
+                "ts": self._tracer.now_us()}
 
     def fence(self, tokens):
         """Register device values to sync before the span closes.
@@ -102,6 +143,11 @@ class Span:
             stack.remove(self)
         args = dict(self._attrs)
         args["depth"] = self._depth
+        args["span_id"] = self.span_id
+        if self._parent_id is not None:
+            args["parent_span_id"] = self._parent_id
+        if self.trace_id is not None:
+            args["trace_id"] = self.trace_id
         if self._tokens is not None:
             args["dispatch_us"] = round(dispatch_ns / 1e3, 3)
             args["device_wait_us"] = round(
@@ -112,7 +158,7 @@ class Span:
             "cat": "glt",
             "ts": round((self._t0_ns - self._tracer._t0_ns) / 1e3, 3),
             "dur": round((end_ns - self._t0_ns) / 1e3, 3),
-            "pid": os.getpid(),
+            "pid": self._tracer.pid,
             "tid": threading.get_ident(),
             "args": args,
         })
@@ -123,6 +169,9 @@ class _NullSpan:
     """Shared no-op span served while no tracer is installed."""
 
     __slots__ = ()
+
+    span_id = None
+    trace_id = None
 
     def __enter__(self):
         return self
@@ -136,18 +185,39 @@ class _NullSpan:
     def set(self, **attrs):
         pass
 
+    def link(self, trace_id, parent_span_id):
+        return self
+
+    def context(self):
+        return None
+
 
 _NULL_SPAN = _NullSpan()
 
 
 class Tracer:
-    """Collects span events; thread-safe (one span stack per thread)."""
+    """Collects span events; thread-safe (one span stack per thread).
 
-    def __init__(self):
+    ``process_name`` labels this process's track in merged traces (the
+    Chrome-trace ``process_name`` metadata event); ``now_us`` is the
+    tracer's clock — microseconds since the tracer started, the same
+    scale every event's ``ts`` uses, and the clock the cross-process
+    sync samples (``obs.clock_sync``) are taken in.
+    """
+
+    def __init__(self, process_name: Optional[str] = None):
         self._events: List[dict] = []
         self._lock = threading.Lock()
         self._local = threading.local()
         self._t0_ns = time.perf_counter_ns()
+        self.pid = os.getpid()
+        self.process_name = process_name
+        # Span ids must not collide across the fleet's processes (merge
+        # stitches remote parent links by id): random high bits + a
+        # process-local counter.
+        self._span_id_base = (
+            struct.unpack("<Q", os.urandom(8))[0] & ~0xFFFFF)
+        self._span_seq = 0
 
     def _stack(self) -> List[Span]:
         stack = getattr(self._local, "stack", None)
@@ -155,8 +225,31 @@ class Tracer:
             stack = self._local.stack = []
         return stack
 
+    def _next_span_id(self) -> int:
+        with self._lock:
+            self._span_seq += 1
+            return self._span_id_base + self._span_seq
+
+    def now_us(self) -> float:
+        """Current time in this tracer's clock (us since tracer start)."""
+        return (time.perf_counter_ns() - self._t0_ns) / 1e3
+
     def span(self, name: str, **attrs) -> Span:
         return Span(self, name, attrs)
+
+    def instant(self, name: str, **args) -> None:
+        """Emit a zero-duration instant event (``ph: "i"``) — used for
+        point occurrences like clock-sync samples, replays, reconnects."""
+        self._emit({
+            "name": name,
+            "ph": "i",
+            "s": "t",
+            "cat": "glt",
+            "ts": round(self.now_us(), 3),
+            "pid": self.pid,
+            "tid": threading.get_ident(),
+            "args": args,
+        })
 
     def _emit(self, event: dict) -> None:
         with self._lock:
@@ -171,10 +264,34 @@ class Tracer:
         with self._lock:
             self._events.clear()
 
+    def metadata_events(self) -> List[dict]:
+        """Chrome ``ph: "M"`` metadata naming this process's track.
+
+        Without these, Perfetto renders a merged multi-process trace as
+        anonymous numeric pids; with them each process is one named
+        track (``client``, ``server``, ``worker0`` ...)."""
+        if not self.process_name:
+            return []
+        return [{
+            "name": "process_name",
+            "ph": "M",
+            "pid": self.pid,
+            "tid": 0,
+            "args": {"name": self.process_name},
+        }]
+
     def chrome_trace(self) -> dict:
         """The trace as a Chrome-trace-format object (JSON-serializable)."""
-        events = sorted(self.events, key=lambda e: e["ts"])
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        events = sorted(self.events, key=lambda e: e.get("ts", 0.0))
+        out = {"traceEvents": self.metadata_events() + events,
+               "displayTimeUnit": "ms"}
+        # Sidecar identity for `obs merge`: which process wrote this
+        # file, and that all ts are tracer-relative (arbitrary origin
+        # per process — exactly what the clock alignment estimates).
+        out["glt"] = {"pid": self.pid,
+                      "process_name": self.process_name,
+                      "clock": "tracer_relative_us"}
+        return out
 
     def export(self, path: str) -> str:
         """Write the Chrome-trace JSON to ``path``; returns ``path``."""
@@ -198,11 +315,51 @@ def current() -> Optional[Tracer]:
     return _current
 
 
-def start_trace() -> Tracer:
-    """Install (and return) a fresh global tracer."""
-    tracer = Tracer()
+def start_trace(process_name: Optional[str] = None) -> Tracer:
+    """Install (and return) a fresh global tracer.
+
+    ``process_name`` labels this process's track in merged traces
+    (e.g. ``"client"``, ``"server"``, ``"worker0"``).
+    """
+    tracer = Tracer(process_name=process_name)
     install(tracer)
     return tracer
+
+
+#: Env var: when set to a directory, fleet roles (DistServer, remote
+#: loaders, mp sampling workers) auto-start a process-global tracer and
+#: export to ``$GLT_OBS_TRACE_DIR/trace-<role>-<pid>.json`` at shutdown.
+TRACE_DIR_ENV = "GLT_OBS_TRACE_DIR"
+
+
+def auto_trace(role: str) -> Optional[str]:
+    """Opt-in per-process tracing for fleet roles.
+
+    If :data:`TRACE_DIR_ENV` names a directory, ensure a global tracer
+    is running (naming it ``role`` if it has no name yet) and return the
+    path this process should export to at teardown; otherwise return
+    ``None`` and touch nothing.  Callers hold the path and call
+    :func:`auto_trace_export` when the role shuts down.
+    """
+    trace_dir = os.environ.get(TRACE_DIR_ENV)
+    if not trace_dir:
+        return None
+    tracer = _current
+    if tracer is None:
+        tracer = start_trace(process_name=role)
+    elif tracer.process_name is None:
+        tracer.process_name = role
+    return os.path.join(trace_dir, f"trace-{role}-{os.getpid()}.json")
+
+
+def auto_trace_export(path: Optional[str]) -> Optional[str]:
+    """Export the global tracer to ``path`` (from :func:`auto_trace`);
+    no-op when ``path`` is None or tracing stopped in the meantime."""
+    tracer = _current
+    if path is None or tracer is None:
+        return None
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    return tracer.export(path)
 
 
 def stop_trace(path: Optional[str] = None) -> Optional[Tracer]:
@@ -244,9 +401,15 @@ def validate_chrome_trace(obj: Any) -> List[str]:
     if not isinstance(events, list):
         return ["traceEvents must be a list"]
     by_tid: Dict[tuple, List[dict]] = {}
+    # Required keys per phase: complete events carry timing; instants
+    # carry a timestamp; metadata events only name a track.
+    required = {"X": ("name", "ph", "ts", "dur", "pid", "tid"),
+                "i": ("name", "ph", "ts", "pid", "tid"),
+                "M": ("name", "ph", "pid")}
     for i, ev in enumerate(events):
-        missing = [k for k in ("name", "ph", "ts", "dur", "pid", "tid")
-                   if k not in ev]
+        keys = required.get(ev.get("ph"), ("name", "ph", "ts", "dur",
+                                           "pid", "tid"))
+        missing = [k for k in keys if k not in ev]
         if missing:
             problems.append(f"event {i} missing keys {missing}")
             continue

@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""Distributed-tracing demo: a traced remote-sampling fleet end to end.
+
+Runs a sampling server in a subprocess (plus optional mp sampling
+workers) and a client in this process, all with per-process tracing on
+(``GLT_OBS_TRACE_DIR``); after one epoch, every process has exported
+its own trace file and this script stitches them with the same code
+``python -m glt_tpu.obs merge`` uses, validates the result, and prints
+the span summary.
+
+    python scripts/trace_demo.py --out-dir /tmp/fleet_trace --workers 1
+
+Load ``merged.json`` in https://ui.perfetto.dev: one named track per
+process (client / server / worker0), client fetch spans parenting the
+server's stage spans after clock alignment.  CI runs this in the
+``bench-compare`` job and uploads the merged trace as an artifact.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N = 48
+
+
+def build_demo_dataset():
+    """Tiny ring graph; top-level so mp spawn workers can rebuild it."""
+    import numpy as np
+
+    from glt_tpu.data import Dataset
+
+    src = np.repeat(np.arange(N), 2)
+    dst = np.concatenate([[(i + 1) % N, (i + 2) % N] for i in range(N)])
+    feat = (np.arange(N, dtype=np.float32)[:, None]
+            * np.ones((1, 4), np.float32))
+    return (Dataset()
+            .init_graph(np.stack([src, dst]), graph_mode="HOST",
+                        num_nodes=N)
+            .init_node_features(feat)
+            .init_node_labels(np.arange(N) % 3))
+
+
+def _server_proc(trace_dir: str, q, workers: int) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["GLT_OBS_TRACE_DIR"] = trace_dir
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from glt_tpu.distributed import init_server
+
+    srv = init_server(build_demo_dataset(),
+                      dataset_builder=build_demo_dataset if workers
+                      else None)
+    q.put(srv.addr)
+    srv.wait_for_exit(timeout=300)
+    srv.shutdown()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out-dir", default="/tmp/glt_trace_demo")
+    parser.add_argument("--workers", type=int, default=1,
+                        help="mp sampling workers on the server "
+                             "(0 = in-server producer thread)")
+    args = parser.parse_args(argv)
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["GLT_OBS_TRACE_DIR"] = args.out_dir
+
+    import numpy as np
+
+    from glt_tpu import obs
+    from glt_tpu.distributed import (
+        RemoteNeighborLoader,
+        RemoteSamplingWorkerOptions,
+    )
+
+    ctx = multiprocessing.get_context("spawn")
+    q = ctx.Queue()
+    proc = ctx.Process(target=_server_proc,
+                       args=(args.out_dir, q, args.workers))
+    proc.start()
+    addr = tuple(q.get(timeout=300))
+    print(f"server up at {addr} (pid {proc.pid})")
+
+    t0 = time.time()
+    loader = RemoteNeighborLoader(
+        addr, [3, 2], np.arange(N), batch_size=8,
+        worker_options=RemoteSamplingWorkerOptions(
+            num_workers=args.workers,
+            channel_capacity_bytes=1 << 20))
+    nbatches = sum(1 for _ in loader)
+    print(f"epoch: {nbatches} batches in {time.time() - t0:.2f}s")
+    loader.shutdown(exit_server=True)
+    proc.join(timeout=60)
+
+    files = sorted(f for f in os.listdir(args.out_dir)
+                   if f.startswith("trace-"))
+    print(f"per-process traces: {files}")
+    paths = [os.path.join(args.out_dir, f) for f in files]
+    merged_path = os.path.join(args.out_dir, "merged.json")
+    merged = obs.merge_traces(paths, out=merged_path)
+    problems = obs.validate_chrome_trace(merged)
+    for p in problems:
+        print(f"INVALID: {p}")
+    nest = obs.span_tree_check(merged, tol_us=5_000.0)
+    for p in nest:
+        print(f"NESTING: {p}")
+    print(f"clock offsets (us): {merged['glt']['clock_offsets_us']}")
+    print(f"merged -> {merged_path} "
+          f"({len(merged['traceEvents'])} events)")
+    rows = obs.summarize_trace(merged)
+    print(obs.format_summary(rows[:12]))
+    return 1 if (problems or nest) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

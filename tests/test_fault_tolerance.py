@@ -432,3 +432,49 @@ def test_multi_epoch_chaos():
     finally:
         loader.shutdown()
         srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Distributed tracing under faults (ISSUE 7 satellite): replay and
+# reconnect events land in the trace, tagged with the originating
+# epoch's trace id.
+# ---------------------------------------------------------------------------
+
+def test_replay_and_reconnect_events_carry_trace_id():
+    """Server-side drops force replays + client reconnects; both must
+    appear in the trace as events tagged with the epoch's trace id, so
+    a merged fleet trace attributes the recovery storm to the batch
+    stream that suffered it."""
+    from glt_tpu import obs
+
+    plan = FaultPlan(drop_after_frames=3)
+    srv = init_server(build_ring_dataset(), fault_plan=plan)
+    tracer = obs.start_trace(process_name="chaos")
+    try:
+        loader = RemoteNeighborLoader(
+            srv.addr, [2, 2], np.arange(N), batch_size=2,
+            worker_options=RemoteSamplingWorkerOptions(**FAST))
+        try:
+            seen = run_epoch(loader)
+            assert_exactly_once(loader, seen)
+            assert loader.epoch_stats["reconnects"] >= 1
+        finally:
+            loader.shutdown()
+        events = tracer.events
+        epoch_ev = next(e for e in events if e["name"] == "remote.epoch")
+        tid = epoch_ev["args"]["trace_id"]
+        replays = [e for e in events if e["name"] == "server.replay"]
+        reconnects = [e for e in events
+                      if e["name"] == "remote.reconnect"]
+        assert replays, "server replays left no trace events"
+        assert reconnects, "client reconnects left no trace events"
+        assert all(e["args"]["trace_id"] == tid for e in replays)
+        assert all(e["args"]["trace_id"] == tid for e in reconnects)
+        # fetch spans of the same epoch share the trace id and mark the
+        # replayed deliveries
+        fetches = [e for e in events if e["name"] == "server.fetch"]
+        assert any(e["args"].get("replayed") for e in fetches)
+        assert obs.validate_chrome_trace(tracer.chrome_trace()) == []
+    finally:
+        obs.install(None)
+        srv.shutdown()

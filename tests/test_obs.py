@@ -275,6 +275,124 @@ class TestMetrics:
         assert metrics.snapshot()["glt.t.noop"] == 0.0
 
 
+class TestHistogramQuantiles:
+    """ISSUE 7 satellite: linear-interpolated quantiles + snapshot
+    p50/p95/p99 so the regression harness and serving SLOs read
+    latencies without re-deriving from raw buckets."""
+
+    def test_quantile_linear_interpolation(self):
+        metrics.enable()
+        h = metrics.histogram("glt.t.q_ms", buckets=(1.0, 2.0, 4.0))
+        # 4 samples in (1, 2]: cumulative 0 / 4 / 4.
+        for v in (1.2, 1.4, 1.6, 1.8):
+            h.observe(v)
+        # Median rank 2 of 4 -> midpoint of the (1, 2] bucket.
+        assert h.quantile(0.5) == pytest.approx(1.5)
+        assert h.quantile(1.0) == pytest.approx(2.0)
+        assert h.quantile(0.25) == pytest.approx(1.25)
+
+    def test_quantile_across_buckets(self):
+        metrics.enable()
+        h = metrics.histogram("glt.t.q2_ms", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 0.5, 3.0, 3.0):       # 2 in (0,1], 2 in (2,4]
+            h.observe(v)
+        assert h.quantile(0.5) == pytest.approx(1.0)   # edge of bucket 1
+        assert h.quantile(0.75) == pytest.approx(3.0)  # mid bucket 3
+        # +Inf tail clamps to the highest finite edge
+        h.observe(100.0)
+        assert h.quantile(0.99) == pytest.approx(4.0)
+
+    def test_quantile_empty_is_nan(self):
+        metrics.enable()
+        h = metrics.histogram("glt.t.q3_ms")
+        assert np.isnan(h.quantile(0.5))
+
+    def test_snapshot_reports_percentiles(self):
+        metrics.enable()
+        h = metrics.histogram("glt.t.lat2_ms", buckets=(1.0, 10.0, 100.0))
+        for v in (0.5, 5.0, 50.0):
+            h.observe(v)
+        snap = metrics.snapshot()
+        assert snap["glt.t.lat2_ms.count"] == 3.0
+        assert 0 < snap["glt.t.lat2_ms.p50"] <= 10.0
+        assert snap["glt.t.lat2_ms.p95"] <= 100.0
+        assert snap["glt.t.lat2_ms.p99"] <= 100.0
+        assert snap["glt.t.lat2_ms.p50"] <= snap["glt.t.lat2_ms.p99"]
+        # empty histograms contribute no percentile keys (no NaN noise)
+        metrics.histogram("glt.t.empty_ms")
+        assert "glt.t.empty_ms.p50" not in metrics.snapshot()
+
+
+class TestProcessMetadata:
+    """ISSUE 7 satellite: exports carry pid/process_name metadata so
+    merged traces render one named track per process in Perfetto."""
+
+    def test_export_names_the_process(self, tmp_path):
+        obs.start_trace(process_name="client")
+        with obs.span("work"):
+            pass
+        path = str(tmp_path / "t.json")
+        obs.stop_trace(path)
+        obj = json.load(open(path))
+        assert validate_chrome_trace(obj) == []
+        meta = [e for e in obj["traceEvents"] if e.get("ph") == "M"]
+        assert meta and meta[0]["name"] == "process_name"
+        assert meta[0]["args"]["name"] == "client"
+        assert obj["glt"]["process_name"] == "client"
+        assert obj["glt"]["pid"] == meta[0]["pid"]
+
+    def test_validator_accepts_instants_and_metadata(self):
+        tracer = obs.start_trace(process_name="p")
+        tracer.instant("obs.clock_sync", peer_pid=1, t0_us=0.0,
+                       t1_us=1.0, t2_us=2.0, t3_us=3.0)
+        with obs.span("x"):
+            pass
+        obj = obs.stop_trace().chrome_trace()
+        assert validate_chrome_trace(obj) == []
+        phases = {e["ph"] for e in obj["traceEvents"]}
+        assert phases == {"M", "i", "X"}
+
+    def test_span_ids_and_local_parent_links(self):
+        obs.start_trace()
+        with obs.span("outer") as outer:
+            ctx = outer.context()
+            with obs.span("inner"):
+                pass
+        events = obs.stop_trace().events
+        by_name = {e["name"]: e for e in events}
+        assert by_name["inner"]["args"]["parent_span_id"] \
+            == by_name["outer"]["args"]["span_id"]
+        # context() rooted a trace id; the child inherited it
+        assert ctx["tid"] == by_name["outer"]["args"]["trace_id"]
+        assert by_name["inner"]["args"]["trace_id"] == ctx["tid"]
+
+    def test_remote_link_sets_parent(self):
+        obs.start_trace()
+        with obs.span("server_side") as sp:
+            sp.link("abcd1234", 777)
+        (ev,) = obs.stop_trace().events
+        assert ev["args"]["trace_id"] == "abcd1234"
+        assert ev["args"]["parent_span_id"] == 777
+
+
+class TestSummarizeJson:
+    def test_summarize_json_cli(self, tmp_path):
+        obs.start_trace()
+        with obs.span("epoch"):
+            with obs.span("step"):
+                time.sleep(0.001)
+        path = str(tmp_path / "t.json")
+        obs.stop_trace(path)
+        out = subprocess.run(
+            [sys.executable, "-m", "glt_tpu.obs", "summarize", path,
+             "--json"], capture_output=True, text=True)
+        assert out.returncode == 0
+        rows = json.loads(out.stdout)
+        by_name = {r["name"]: r for r in rows}
+        assert by_name["step"]["count"] == 1
+        assert {"total_ms", "self_ms", "mean_ms"} <= set(by_name["epoch"])
+
+
 # ---------------------------------------------------------------------------
 # unified stats namespace (cache + remote loader re-exports)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,195 @@
+"""Perf-regression harness (ISSUE 7 tentpole part 3).
+
+Direction table, noise-tolerant thresholds, stuck-metric detection over
+the COMMITTED BENCH_r0*.json history (the acceptance criterion: the
+known-stuck ``overlap_speedup`` is flagged), and the
+``scripts/bench_compare.py`` CLI.  Stdlib-only — no jax.
+"""
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from glt_tpu.obs.regress import (
+    DOWN,
+    NEUTRAL,
+    UP,
+    compare,
+    direction,
+    load_bench_metrics,
+    markdown_report,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestDirections:
+    @pytest.mark.parametrize("metric,expected", [
+        ("value", UP),
+        ("gather_gb_s_dedup", UP),
+        ("gather_roofline_frac", UP),
+        ("memcpy_roofline_gb_s", UP),
+        ("train_step_tflops_bf16", UP),
+        ("batched_g8_m_edges_s", UP),
+        ("subgraphs_per_s", UP),
+        ("overlap_speedup", UP),
+        ("cache_hit_rate", UP),
+        ("sample_ms", DOWN),
+        ("gather_xla_ms_d128", DOWN),
+        ("dist_sample_ms_sort", DOWN),
+        ("serialized_ms_per_batch", DOWN),
+        ("epoch_s_config1_measured", DOWN),
+        ("epoch_best", DOWN),
+        ("obs_noop_ns_per_call", DOWN),
+        ("obs_disabled_overhead_frac", DOWN),
+        ("sampling_overhead_frac", DOWN),
+        ("tunnel_rtt_ms", NEUTRAL),
+        ("node_cap_calibrated", NEUTRAL),
+        ("occupancy_p99", NEUTRAL),
+    ])
+    def test_direction_table(self, metric, expected):
+        assert direction(metric) == expected
+
+
+class TestCompare:
+    def test_regression_flagged_beyond_threshold(self):
+        runs = [("r1", {"step_ms": 50.0}), ("r2", {"step_ms": 50.5}),
+                ("r3", {"step_ms": 49.8}), ("fresh", {"step_ms": 60.0})]
+        rep = compare(runs)
+        assert rep["verdict"] == "regress"
+        assert rep["regressions"] == ["step_ms"]
+
+    def test_improvement_flagged(self):
+        runs = [("r1", {"x_gb_s": 10.0}), ("r2", {"x_gb_s": 10.2}),
+                ("fresh", {"x_gb_s": 14.0})]
+        rep = compare(runs)
+        assert rep["improvements"] == ["x_gb_s"]
+        assert rep["verdict"] == "improve"
+
+    def test_noise_tolerance_suppresses_jitter(self):
+        # History noise (MAD) wider than the latest delta: no verdict.
+        runs = [("r1", {"step_ms": 50.0}), ("r2", {"step_ms": 58.0}),
+                ("r3", {"step_ms": 44.0}), ("fresh", {"step_ms": 56.0})]
+        rep = compare(runs)
+        assert rep["verdict"] == "ok"
+        assert rep["regressions"] == []
+
+    def test_direction_awareness_ms_down_is_good(self):
+        runs = [("r1", {"step_ms": 50.0, "x_gb_s": 10.0}),
+                ("fresh", {"step_ms": 40.0, "x_gb_s": 8.0})]
+        rep = compare(runs)
+        assert "step_ms" in rep["improvements"]   # lower ms = better
+        assert "x_gb_s" in rep["regressions"]       # lower gb/s = worse
+
+    def test_neutral_metric_never_verdicted(self):
+        runs = [("r1", {"tunnel_rtt_ms": 10.0}),
+                ("fresh", {"tunnel_rtt_ms": 500.0})]
+        rep = compare(runs)
+        assert rep["verdict"] == "ok"
+        (row,) = [r for r in rep["rows"]
+                  if r["metric"] == "tunnel_rtt_ms"]
+        assert row["status"] == "info"
+
+    def test_stuck_requires_flat_and_unmet_target(self):
+        flat_unmet = [("r1", {"overlap_speedup": 0.97}),
+                      ("r2", {"overlap_speedup": 0.99}),
+                      ("fresh", {"overlap_speedup": 0.98})]
+        assert compare(flat_unmet)["stuck"] == ["overlap_speedup"]
+        met = [("r1", {"overlap_speedup": 1.20}),
+               ("r2", {"overlap_speedup": 1.21}),
+               ("fresh", {"overlap_speedup": 1.20})]
+        assert compare(met)["stuck"] == []
+
+    def test_new_and_gone_metrics(self):
+        runs = [("r1", {"old_ms": 5.0}),
+                ("fresh", {"fresh_ms": 1.0})]
+        rep = compare(runs)
+        by = {r["metric"]: r["status"] for r in rep["rows"]}
+        assert by["fresh_ms"] == "new"
+        assert by["old_ms"] == "gone"
+
+    def test_strings_skipped(self):
+        runs = [("r1", {"gather_path": "dedup", "x_ms": 2.0}),
+                ("fresh", {"gather_path": "naive", "x_ms": 2.0})]
+        rep = compare(runs)
+        assert all(r["metric"] != "gather_path" for r in rep["rows"])
+
+
+class TestCommittedHistory:
+    """The acceptance criterion: over BENCH_r01-r05 plus a fresh run,
+    the known-stuck overlap_speedup (0.966 / 0.991 / ... while the
+    overlapped path needs > 1) is flagged."""
+
+    def _history(self):
+        runs = []
+        for path in sorted(glob.glob(os.path.join(REPO,
+                                                  "BENCH_r*.json"))):
+            metrics = load_bench_metrics(path)
+            assert metrics is not None, path
+            runs.append((os.path.basename(path), metrics))
+        return runs
+
+    def test_history_loads_all_five_rounds(self):
+        runs = self._history()
+        assert len(runs) >= 5
+        assert all("value" in m for _, m in runs)
+
+    def test_overlap_speedup_flagged_stuck(self):
+        runs = self._history()
+        # A fresh run that repeats the r05 numbers — exactly the
+        # "nothing moved again" state the harness must surface.
+        runs.append(("fresh", dict(runs[-1][1])))
+        rep = compare(runs)
+        assert "overlap_speedup" in rep["stuck"]
+
+    def test_markdown_trend_table(self):
+        runs = self._history()
+        runs.append(("fresh", dict(runs[-1][1])))
+        md = markdown_report(compare(runs))
+        assert "| `overlap_speedup` |" in md
+        assert "stuck" in md
+        assert "Verdict" in md
+        # one column per run + metric + delta + status
+        header = [ln for ln in md.splitlines()
+                  if ln.startswith("| metric")][0]
+        assert header.count("|") == len(runs) + 4
+
+
+class TestCLI:
+    def test_bench_compare_cli_advisory(self, tmp_path):
+        out_md = str(tmp_path / "report.md")
+        out_json = str(tmp_path / "report.json")
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "bench_compare.py"),
+             "--history", os.path.join(REPO, "BENCH_r*.json"),
+             "--out", out_md, "--json", out_json],
+            capture_output=True, text=True)
+        # Advisory: exit 0 even though history contains regressions.
+        assert res.returncode == 0, res.stderr
+        assert "Bench trend report" in res.stdout
+        assert os.path.exists(out_md)
+        rep = json.load(open(out_json))
+        assert rep["labels"][0] == "r01"
+        assert any(r["metric"] == "overlap_speedup" for r in rep["rows"])
+
+    def test_bench_compare_fresh_run_and_strict(self, tmp_path):
+        # A fresh GLT_BENCH_OUT-style file (raw bench JSON line) with a
+        # clear regression; --strict must exit 1.
+        base = load_bench_metrics(os.path.join(REPO, "BENCH_r05.json"))
+        fresh = dict(base)
+        fresh["gather_ms"] = base["gather_ms"] * 3.0
+        fpath = str(tmp_path / "fresh.json")
+        with open(fpath, "w") as f:
+            f.write(json.dumps(fresh) + "\n")
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "bench_compare.py"),
+             "--history", os.path.join(REPO, "BENCH_r*.json"),
+             "--fresh", fpath, "--strict"],
+            capture_output=True, text=True)
+        assert res.returncode == 1
+        assert "`gather_ms`" in res.stdout

@@ -1,5 +1,13 @@
 """Server-client deployment tests (cf. test_dist_neighbor_loader.py's
 server-client topology, :173-371): real sockets, real producer threads."""
+import glob
+import json
+import multiprocessing
+import os
+import socket
+import struct
+import threading
+
 import numpy as np
 import pytest
 
@@ -237,6 +245,281 @@ def test_two_servers_two_clients():
             ld.shutdown()
         for srv in servers:
             srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Distributed tracing (ISSUE 7 tentpole): per-process traces, clock-aligned
+# merge, server stage histograms, mixed-version compatibility.
+# ---------------------------------------------------------------------------
+
+def _traced_server_proc(trace_dir, q, num_workers):
+    """Subprocess body: a sampling server with per-process tracing on
+    (GLT_OBS_TRACE_DIR), exporting its trace file at shutdown."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["GLT_OBS_TRACE_DIR"] = trace_dir
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from glt_tpu.distributed.dist_server import init_server as _init
+    from tests.test_dist_loader import build_ring_dataset as _build
+
+    srv = _init(_build(),
+                dataset_builder=_build if num_workers else None)
+    q.put(srv.addr)
+    srv.wait_for_exit(timeout=120)
+    srv.shutdown()          # exports trace-server-<pid>.json
+
+
+def _run_traced_fleet(tmp_path, monkeypatch, num_workers):
+    """Client (this process) + server (subprocess) [+ mp workers] with
+    tracing on everywhere; returns (trace files, merged trace, client
+    epoch trace id)."""
+    from glt_tpu import obs
+    from glt_tpu.distributed import RemoteSamplingWorkerOptions
+
+    trace_dir = str(tmp_path)
+    monkeypatch.setenv("GLT_OBS_TRACE_DIR", trace_dir)
+    ctx = multiprocessing.get_context("spawn")
+    q = ctx.Queue()
+    # Non-daemonic: the mp-worker variant needs the server process to
+    # spawn children of its own; the finally below reaps it regardless.
+    proc = ctx.Process(target=_traced_server_proc,
+                       args=(trace_dir, q, num_workers), daemon=False)
+    proc.start()
+    try:
+        addr = tuple(q.get(timeout=120))
+        loader = RemoteNeighborLoader(
+            addr, [2, 2], np.arange(N), batch_size=6,
+            worker_options=RemoteSamplingWorkerOptions(
+                num_workers=num_workers,
+                channel_capacity_bytes=1 << 20))
+        seen = []
+        for batch in loader:
+            check_batch(batch)
+            seen.extend(
+                np.asarray(batch.batch)[:batch.batch_size].tolist())
+        assert sorted(seen) == list(range(N))
+        tracer = obs.current()
+        assert tracer is not None     # auto-installed by GLT_OBS_TRACE_DIR
+        epoch_ev = next(e for e in tracer.events
+                        if e["name"] == "remote.epoch")
+        epoch_tid = epoch_ev["args"]["trace_id"]
+        loader.shutdown(exit_server=True)   # exports the client trace too
+        proc.join(timeout=60)
+        assert proc.exitcode == 0
+    finally:
+        obs.install(None)
+        if proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=10)
+    files = sorted(glob.glob(os.path.join(trace_dir, "trace-*.json")))
+    merged = obs.merge_traces(files)
+    return files, merged, epoch_tid
+
+
+def test_distributed_trace_merge_end_to_end(tmp_path, monkeypatch):
+    """ISSUE 7 acceptance: a remote-sampling run exports per-process
+    traces that `obs merge` stitches into one valid Chrome trace, with
+    client request spans parenting server stage spans after clock
+    alignment."""
+    from glt_tpu import obs
+
+    files, merged, epoch_tid = _run_traced_fleet(tmp_path, monkeypatch,
+                                                 num_workers=0)
+    roles = {os.path.basename(f).split("-")[1] for f in files}
+    assert {"client", "server"} <= roles      # one file per process
+    assert obs.validate_chrome_trace(merged) == []
+    # Server stage spans nest inside the client fetch spans that caused
+    # them (5 ms slack: loopback RTT bounds the alignment error).
+    assert obs.span_tree_check(merged, tol_us=5_000.0) == []
+    by_name = {}
+    for ev in merged["traceEvents"]:
+        by_name.setdefault(ev.get("name"), []).append(ev)
+    # One causally-linked tree: client epoch/fetch spans, server request
+    # + producer spans all tagged with the SAME trace id.
+    assert any(e["args"].get("trace_id") == epoch_tid
+               for e in by_name.get("server.fetch", []))
+    assert any(e["args"].get("trace_id") == epoch_tid
+               for e in by_name.get("producer.sample_batch", []))
+    # The clock offset was actually estimated (exact-0 for every file
+    # would mean no sync samples were exchanged).
+    offsets = merged["glt"]["clock_offsets_us"]
+    assert len(offsets) == len(files)
+    assert merged["glt"]["unaligned_pids"] == []
+
+
+@pytest.mark.slow
+def test_distributed_trace_merge_with_mp_workers(tmp_path, monkeypatch):
+    """Full client -> server -> mp-worker chain: the worker's trace file
+    joins the merge through one-way shm clock samples (transitive
+    alignment worker -> server -> client)."""
+    from glt_tpu import obs
+
+    files, merged, epoch_tid = _run_traced_fleet(tmp_path, monkeypatch,
+                                                 num_workers=1)
+    roles = {os.path.basename(f).split("-")[1] for f in files}
+    assert {"client", "server", "worker0"} <= roles
+    assert obs.validate_chrome_trace(merged) == []
+    assert merged["glt"]["unaligned_pids"] == []
+    worker_spans = [e for e in merged["traceEvents"]
+                    if e.get("name") == "worker.sample_batch"]
+    assert worker_spans
+    assert any(e["args"].get("trace_id") == epoch_tid
+               for e in worker_spans)
+
+
+def test_server_stage_histograms(server):
+    """ISSUE 7 acceptance: glt.server.* stage histograms with derived
+    p50/p95/p99 in snapshot() and buckets in metrics_text()."""
+    from glt_tpu.obs import metrics
+
+    metrics.enable()
+    try:
+        loader = RemoteNeighborLoader(server.addr, [2, 2], np.arange(N),
+                                      batch_size=6)
+        try:
+            for batch in loader:
+                check_batch(batch)
+            snap = metrics.snapshot()
+            for stage in ("queue_wait", "sample", "serialize", "send"):
+                name = f"glt.server.{stage}_ms"
+                assert snap[f"{name}.count"] >= len(loader), name
+                for p in ("p50", "p95", "p99"):
+                    assert f"{name}.{p}" in snap, f"{name}.{p}"
+                assert snap[f"{name}.p50"] <= snap[f"{name}.p99"]
+            text = server.metrics_text()
+            assert "# TYPE glt_server_queue_wait_ms histogram" in text
+            assert "glt_server_sample_ms_bucket" in text
+            assert "glt_server_send_ms_count" in text
+        finally:
+            loader.shutdown()
+    finally:
+        metrics.disable()
+
+
+def test_old_client_against_traced_server():
+    """Mixed-version (ISSUE 7 satellite): a pre-trace client — requests
+    WITHOUT the #trace key — against a tracing server must receive
+    byte-compatible frames: no trailer, payload parses with the old
+    code path verbatim."""
+    from glt_tpu import obs
+    from glt_tpu.channel.serialization import deserialize
+    from glt_tpu.distributed.dist_server import (_KIND_JSON, _KIND_MSG,
+                                                 recv_frame, send_frame)
+
+    srv = init_server(build_ring_dataset())
+    obs.start_trace(process_name="server")     # server side IS tracing
+    try:
+        raw = socket.create_connection(srv.addr, timeout=10)
+        raw.settimeout(10)
+        try:
+            def old_request(**req):
+                send_frame(raw, _KIND_JSON, json.dumps(req).encode())
+                return recv_frame(raw)
+
+            kind, data = old_request(op="create_sampling_producer",
+                                     num_neighbors=[2],
+                                     input_nodes=list(range(N)),
+                                     batch_size=6)
+            assert kind == _KIND_JSON
+            resp = json.loads(data)
+            pid = resp["producer_id"]
+            # old peers must not even see the echo key in JSON responses
+            kind, data = old_request(op="start_new_epoch_sampling",
+                                     producer_id=pid, epoch=1)
+            assert "#trace" not in json.loads(data)
+            kind, data = old_request(op="fetch_one_sampled_message",
+                                     producer_id=pid, epoch=1, ack=-1)
+            assert kind == _KIND_MSG
+            # exact OLD parsing: u64 seq + serialized message, with no
+            # trailer appended (the magic footer must be absent).
+            assert not data.endswith(b"GLTT")
+            seq = struct.unpack_from("<Q", data, 0)[0]
+            assert seq == 0
+            msg = deserialize(memoryview(data)[8:])
+            assert "node" in msg
+            old_request(op="destroy_sampling_producer", producer_id=pid)
+        finally:
+            raw.close()
+    finally:
+        obs.install(None)
+        srv.shutdown()
+
+
+def _old_style_server(listener, canned, stop):
+    """A pre-PR-7 server: reads only the JSON keys it knows (any extra
+    key — #trace included — is ignored), never sends an echo/trailer."""
+    from glt_tpu.distributed.dist_server import (_KIND_JSON, _KIND_MSG,
+                                                 recv_frame, send_frame)
+
+    while not stop.is_set():
+        try:
+            conn, _ = listener.accept()
+        except OSError:
+            return
+        with conn:
+            seq = 0
+            while True:
+                kind, data = recv_frame(conn)
+                if kind is None:
+                    break
+                req = json.loads(data)
+                op = req["op"]       # old code: known keys only
+                if op == "create_sampling_producer":
+                    send_frame(conn, _KIND_JSON, json.dumps(
+                        {"producer_id": 0,
+                         "num_expected": len(canned)}).encode())
+                elif op == "fetch_one_sampled_message":
+                    send_frame(conn, _KIND_MSG,
+                               struct.pack("<Q", seq) + canned[seq])
+                    seq += 1
+                else:
+                    send_frame(conn, _KIND_JSON, b'{"ok": true}')
+                    if op == "destroy_sampling_producer":
+                        return
+
+
+def test_new_traced_client_against_old_server():
+    """Mixed-version (ISSUE 7 satellite): a tracing client sends #trace;
+    an old server ignores unknown JSON keys and answers plain frames —
+    the run degrades to untraced operation, not a ProtocolError."""
+    from glt_tpu import obs
+    from glt_tpu.distributed.dist_server import _Producer
+
+    # Real sampled messages so message_to_batch round-trips.
+    ds = build_ring_dataset()
+    prod = _Producer(ds, [2, 2], np.arange(12), 6)
+    prod.start_epoch(1)
+    canned = [prod.fetch_next(-1, 1)[1] for _ in range(2)]
+    prod.stop()
+
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(4)
+    stop = threading.Event()
+    t = threading.Thread(target=_old_style_server,
+                         args=(listener, canned, stop), daemon=True)
+    t.start()
+    tracer = obs.start_trace(process_name="client")
+    try:
+        loader = RemoteNeighborLoader(listener.getsockname(), [2, 2],
+                                      np.arange(12), batch_size=6)
+        batches = list(loader)
+        assert len(batches) == 2
+        for b in batches:
+            check_batch(b)
+        loader.shutdown()
+        # Degraded, not broken: spans exist client-side, but no clock
+        # sync ever completed (the old server echoed nothing).
+        names = {e["name"] for e in tracer.events}
+        assert "remote.fetch" in names
+        assert "obs.clock_sync" not in names
+    finally:
+        obs.install(None)
+        stop.set()
+        listener.close()
+        t.join(timeout=10)
 
 
 def test_two_clients_same_server(server):

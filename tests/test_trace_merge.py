@@ -1,0 +1,211 @@
+"""Cross-process trace merging (ISSUE 7 tentpole).
+
+Synthetic per-process trace files with a KNOWN injected clock skew are
+merged by ``obs.merge_traces``; the tests assert the estimated offsets
+recover the injected skew within the NTP error bound and that
+cross-process parent/child spans nest after alignment.  Also covers the
+wire-format helpers (trailer pack/split) and the ``obs merge`` CLI.
+
+Everything here is stdlib-level (no jax): merging is pure JSON work.
+"""
+import json
+import subprocess
+import sys
+
+import pytest
+
+from glt_tpu.obs.merge import estimate_offsets, merge_traces, span_tree_check
+from glt_tpu.obs import propagate
+from glt_tpu.obs.trace import validate_chrome_trace
+
+# Injected skews (us): the server tracer reads THETA_SC ahead of the
+# client's, the worker tracer THETA_WS ahead of the server's.
+THETA_SC = 300_000.0
+THETA_WS = -50_000.0
+
+
+def _client_trace():
+    """Client: one fetch span [10000, 15000] + two NTP sync samples
+    against the server (asymmetric latencies: 180 us out, 120 us back
+    for the good sample; a much worse 2000/1500 sample that the min-RTT
+    filter must reject)."""
+    def sync(t0, t3, lat_out, lat_back):
+        return {"name": "obs.clock_sync", "ph": "i", "s": "t",
+                "ts": t3, "pid": 111, "tid": 1,
+                "args": {"peer_pid": 222, "peer_role": "server",
+                         "t0_us": t0,
+                         "t1_us": t0 + lat_out + THETA_SC,
+                         "t2_us": t3 - lat_back + THETA_SC,
+                         "t3_us": t3}}
+    return {
+        "traceEvents": [
+            {"name": "remote.fetch", "ph": "X", "ts": 10_000.0,
+             "dur": 5_000.0, "pid": 111, "tid": 1,
+             "args": {"span_id": 1111, "trace_id": "t1"}},
+            sync(10_000.0, 15_000.0, 180.0, 120.0),
+            sync(20_000.0, 29_000.0, 2_000.0, 1_500.0),
+        ],
+        "glt": {"pid": 111, "process_name": "client"},
+    }
+
+
+def _server_trace():
+    """Server: a fetch-handling span that (in true time) sits inside the
+    client's fetch span, expressed in the server's skewed clock; plus a
+    one-way sync sample from the worker (two samples, latencies 80 and
+    600 us — the max(t_send - t_recv) bound must pick the 80 us one)."""
+    def oneway(t_send_worker, lat):
+        t_recv_server = t_send_worker - THETA_WS + lat
+        return {"name": "obs.clock_oneway", "ph": "i", "s": "t",
+                "ts": t_recv_server, "pid": 222, "tid": 2,
+                "args": {"peer_pid": 333, "peer_role": "worker",
+                         "t_send_peer_us": t_send_worker,
+                         "t_recv_us": t_recv_server}}
+    return {
+        "traceEvents": [
+            {"name": "server.fetch", "ph": "X",
+             "ts": 10_400.0 + THETA_SC, "dur": 4_000.0,
+             "pid": 222, "tid": 2,
+             "args": {"span_id": 2222, "parent_span_id": 1111,
+                      "trace_id": "t1"}},
+            oneway(7_000.0 + THETA_SC + THETA_WS, 80.0),
+            oneway(8_000.0 + THETA_SC + THETA_WS, 600.0),
+        ],
+        "glt": {"pid": 222, "process_name": "server"},
+    }
+
+
+def _worker_trace():
+    """Worker: a sampling span that in true time is [9000, 9900] (client
+    clock), expressed in the worker's doubly-skewed clock."""
+    return {
+        "traceEvents": [
+            {"name": "worker.sample_batch", "ph": "X",
+             "ts": 9_000.0 + THETA_SC + THETA_WS, "dur": 900.0,
+             "pid": 333, "tid": 3,
+             "args": {"span_id": 3333, "trace_id": "t1"}},
+        ],
+        "glt": {"pid": 333, "process_name": "worker0"},
+    }
+
+
+def _write(tmp_path, name, obj):
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        json.dump(obj, f)
+    return path
+
+
+class TestMerge:
+    def test_known_skew_recovered_within_ntp_bound(self, tmp_path):
+        paths = [_write(tmp_path, "client.json", _client_trace()),
+                 _write(tmp_path, "server.json", _server_trace())]
+        merged = merge_traces(paths)
+        assert validate_chrome_trace(merged) == []
+        off = merged["glt"]["clock_offsets_us"]
+        assert off["111"] == 0.0                      # client = reference
+        # NTP estimate errs by at most the asymmetry of the best sample:
+        # (180 - 120) / 2 = 30 us.
+        assert off["222"] == pytest.approx(THETA_SC, abs=31.0)
+
+    def test_aligned_spans_nest_across_processes(self, tmp_path):
+        paths = [_write(tmp_path, "client.json", _client_trace()),
+                 _write(tmp_path, "server.json", _server_trace())]
+        merged = merge_traces(paths)
+        # The server span's remote parent is the client fetch span; after
+        # alignment it must nest within it (tolerance: the 300 us RTT of
+        # the best sync sample, far wider than the 30 us real error).
+        assert span_tree_check(merged, tol_us=300.0) == []
+        server_ev = next(e for e in merged["traceEvents"]
+                         if e.get("name") == "server.fetch")
+        assert 10_000.0 <= server_ev["ts"]
+        assert server_ev["ts"] + server_ev["dur"] <= 15_000.0 + 300.0
+
+    def test_misaligned_tree_is_reported(self, tmp_path):
+        # Without the alignment (raw skewed files concatenated) the same
+        # check must fail loudly — guard against a silently lying merge.
+        client, server = _client_trace(), _server_trace()
+        raw = {"traceEvents": (client["traceEvents"]
+                               + server["traceEvents"])}
+        assert span_tree_check(raw, tol_us=300.0) != []
+
+    def test_oneway_transitive_worker_alignment(self, tmp_path):
+        paths = [_write(tmp_path, "client.json", _client_trace()),
+                 _write(tmp_path, "server.json", _server_trace()),
+                 _write(tmp_path, "worker.json", _worker_trace())]
+        merged = merge_traces(paths)
+        off = merged["glt"]["clock_offsets_us"]
+        assert merged["glt"]["unaligned_pids"] == []
+        # worker offset composes worker->server (one-way, biased low by
+        # the 80 us min latency) with server->client (NTP, +-30 us).
+        assert off["333"] == pytest.approx(THETA_SC + THETA_WS,
+                                           abs=80.0 + 31.0)
+        worker_ev = next(e for e in merged["traceEvents"]
+                         if e.get("name") == "worker.sample_batch")
+        assert worker_ev["ts"] == pytest.approx(9_000.0, abs=120.0)
+
+    def test_estimate_offsets_min_rtt_filter(self, tmp_path):
+        files = [{"obj": _client_trace(), "pid": 111},
+                 {"obj": _server_trace(), "pid": 222}]
+        off = estimate_offsets(files, ref_pid=111)
+        # The 2000/1500 us sample alone would err by 250 us; the min-RTT
+        # filter must have picked the 180/120 one (error <= 30 us).
+        assert off[222] == pytest.approx(THETA_SC, abs=31.0)
+
+    def test_unaligned_process_kept_and_flagged(self, tmp_path):
+        lonely = {"traceEvents": [
+            {"name": "island", "ph": "X", "ts": 5.0, "dur": 1.0,
+             "pid": 999, "tid": 9}], "glt": {"pid": 999,
+                                             "process_name": "island"}}
+        paths = [_write(tmp_path, "client.json", _client_trace()),
+                 _write(tmp_path, "lonely.json", lonely)]
+        merged = merge_traces(paths)
+        assert merged["glt"]["unaligned_pids"] == [999]
+        ev = next(e for e in merged["traceEvents"]
+                  if e.get("name") == "island")
+        assert ev["ts"] == 5.0        # untouched, not silently shifted
+
+    def test_merged_tracks_are_named(self, tmp_path):
+        paths = [_write(tmp_path, "client.json", _client_trace()),
+                 _write(tmp_path, "server.json", _server_trace())]
+        merged = merge_traces(paths)
+        names = {(e["pid"], e["args"]["name"])
+                 for e in merged["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert (111, "client") in names
+        assert (222, "server") in names
+
+    def test_merge_cli(self, tmp_path):
+        paths = [_write(tmp_path, "client.json", _client_trace()),
+                 _write(tmp_path, "server.json", _server_trace())]
+        out = str(tmp_path / "merged.json")
+        res = subprocess.run(
+            [sys.executable, "-m", "glt_tpu.obs", "merge", "-o", out]
+            + paths, capture_output=True, text=True)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "offset" in res.stdout
+        assert "OK" in res.stdout
+        merged = json.load(open(out))
+        assert validate_chrome_trace(merged) == []
+        assert span_tree_check(merged, tol_us=300.0) == []
+
+
+class TestWireFormat:
+    def test_trailer_roundtrip(self):
+        payload = b"\x00\x01binary-sample-bytes\xff"
+        echo = {"pid": 7, "role": "server", "t1": 1.5, "t2": 2.5}
+        framed = propagate.pack_trailer(payload, echo)
+        assert framed.startswith(payload)       # append-only: prefix intact
+        got_payload, got_echo = propagate.split_trailer(framed)
+        assert bytes(got_payload) == payload
+        assert got_echo == echo
+
+    def test_split_on_plain_frame_is_noop(self):
+        for payload in (b"", b"x", b"plain old payload bytes",
+                        b"ends with magic GLTT"):  # no length prefix
+            got, echo = propagate.split_trailer(payload)
+            assert bytes(got) == payload
+            assert echo is None
+
+    def test_pack_without_echo_is_identity(self):
+        assert propagate.pack_trailer(b"abc", None) == b"abc"
