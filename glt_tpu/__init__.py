@@ -18,8 +18,10 @@ Subpackages:
   distributed  host-side deployment: mp producers, shm channel loader,
              TCP server-client
   channel    SampleMessage serialization + native shm ring queue
+  ckpt       durable data-path checkpoints + bit-identical resume
   obs        tracing (Chrome-trace spans), metrics registry, roofline
   utils      topo/tensor helpers, profiler, checkpointing
+  testing    deterministic fault injection for chaos tests
 """
 
 __version__ = "0.1.0"
@@ -30,7 +32,8 @@ from .typing import EdgeType, NodeType, PADDING_ID  # noqa: F401
 # Subpackages import jax/flax; keep them lazy so `import glt_tpu` is cheap
 # and usable for pure-host tooling (partitioning scripts etc.).
 _SUBMODULES = ("data", "ops", "sampler", "loader", "models", "parallel",
-               "partition", "distributed", "channel", "obs", "utils")
+               "partition", "distributed", "channel", "ckpt", "obs",
+               "utils", "testing")
 
 
 def __getattr__(name):

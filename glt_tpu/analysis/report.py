@@ -20,6 +20,7 @@ from __future__ import annotations
 import enum
 import io
 import json
+import os
 import re
 import tokenize
 from dataclasses import asdict, dataclass, field
@@ -172,9 +173,13 @@ def load_baseline(path: str) -> Set[str]:
 
 def write_baseline(path: str, findings: Iterable[Finding]) -> None:
     keys = sorted({finding_key(f) for f in findings})
-    with open(path, "w", encoding="utf-8") as fh:
+    # Atomic publish (GLT011): CI reads the committed baseline while a
+    # developer may be regenerating it — never expose a torn file.
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
         json.dump({"version": 1, "findings": keys}, fh, indent=2)
         fh.write("\n")
+    os.replace(tmp, path)
 
 
 def split_by_baseline(findings: List[Finding], baseline: Set[str]

@@ -1053,6 +1053,85 @@ class SpanInTracedCode(Rule):
         return None
 
 
+# ---------------------------------------------------------------------------
+# GLT011 non-atomic-state-publish
+# ---------------------------------------------------------------------------
+
+@register
+class NonAtomicStatePublish(Rule):
+    """``open(path, "w")`` publishing state without tmp + ``os.replace``.
+
+    The durable-state discipline (glt_tpu.ckpt.store, channel/native.py):
+    anything another process may read — checkpoints, manifests, trace
+    exports, bench/report artifacts — is written fully under a private
+    tmp name and published with ONE atomic rename.  A direct write to
+    the final path is a torn-read window: a reader (or a crash) midway
+    through the write observes a half-written file that parses as
+    garbage or, worse, parses cleanly as truncated state.
+
+    Flagged: ``open()`` in write/create mode (``w``/``x``/``a`` modes)
+    on a path that is not visibly a tmp name (no ``tmp``/``temp`` in the
+    path expression), in an enclosing function that never publishes via
+    ``os.replace``/``os.rename``/``shutil.move``.  A function that does
+    rename-publish is trusted for all its writes (the tmp file it writes
+    may be named by any expression); genuinely process-private files
+    take a tmp-ish name or a justified suppression.
+    """
+    name = "non-atomic-state-publish"
+    code = "GLT011"
+    severity = Severity.ERROR
+    description = ("direct open(path, 'w') write without the tmp + "
+                   "os.replace atomic-publish discipline")
+
+    _PUBLISH = {"os.replace", "os.rename", "shutil.move"}
+    _WRITE_MODES = ("w", "x", "a")
+
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
+        findings: List[Finding] = []
+        regions = [module.tree] + [
+            s.node for s in module.scopes
+            if not isinstance(s.node, ast.Lambda)]
+        for region in regions:
+            calls = [n for n in _walk_own(region)
+                     if isinstance(n, ast.Call)]
+            if any((module.call_name(c) or _dotted(c.func))
+                   in self._PUBLISH for c in calls):
+                continue
+            for call in calls:
+                mode = self._write_mode(call)
+                if mode is None:
+                    continue
+                path_src = ast.unparse(call.args[0]) if call.args else ""
+                low = path_src.lower()
+                if "tmp" in low or "temp" in low:
+                    continue
+                findings.append(self.finding(
+                    module, call,
+                    f"open({path_src}, {mode!r}) writes the final path "
+                    f"directly: a reader (or this process, killed "
+                    f"mid-write) can observe a torn file — write to a "
+                    f".tmp- sibling and publish with one os.replace "
+                    f"(the glt_tpu.ckpt.store discipline), or name the "
+                    f"path tmp-ish if it is truly process-private"))
+        return findings
+
+    def _write_mode(self, call: ast.Call) -> Optional[str]:
+        if not (isinstance(call.func, ast.Name)
+                and call.func.id == "open" and call.args):
+            return None
+        mode = None
+        if len(call.args) >= 2:
+            mode = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if not (isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)):
+            return None
+        return (mode.value if any(ch in mode.value
+                                  for ch in self._WRITE_MODES) else None)
+
+
 def _iter_const_ints(node: ast.expr) -> Iterator[int]:
     if isinstance(node, ast.Constant) and isinstance(node.value, int):
         yield node.value

@@ -1,0 +1,54 @@
+"""glt_tpu.ckpt — durable data-path checkpoints + bit-identical resume.
+
+The preemption-safety layer (docs/distributed.md "Checkpoint & resume"):
+every stateful data-path component — loader epoch cursor + shuffle rng,
+``FeatureCacheState``, the remote client's seq/ack/epoch accounting,
+model/optimizer pytrees — captures to plain dicts of scalars + arrays,
+serialized atomically (tmp + ``os.replace``, manifest + sha256) into a
+checkpoint directory, and restores **bit-exactly**: a SIGKILLed run
+resumed from its last checkpoint replays the remaining batch stream and
+losses identically to an uninterrupted run.
+
+Layers (inner to outer):
+  store   write_checkpoint/read_checkpoint/latest_step — atomic dirs
+  state   capture/restore for pytrees, np Generators, PRNG keys
+  driver  Checkpointer (cadence/retention/resume) + TrainLoop (the
+          preemption-safe scanned-epoch driver, supervisor-aware)
+"""
+from .driver import Checkpointer, Snapshot, TrainLoop  # noqa: F401
+from .state import (  # noqa: F401
+    capture_key,
+    capture_pytree,
+    capture_rng,
+    load_rng,
+    restore_key,
+    restore_pytree,
+    restore_rng,
+)
+from .store import (  # noqa: F401
+    CheckpointCorruptError,
+    CheckpointError,
+    latest_step,
+    list_steps,
+    read_checkpoint,
+    write_checkpoint,
+)
+
+__all__ = [
+    "Checkpointer",
+    "Snapshot",
+    "TrainLoop",
+    "CheckpointError",
+    "CheckpointCorruptError",
+    "write_checkpoint",
+    "read_checkpoint",
+    "latest_step",
+    "list_steps",
+    "capture_pytree",
+    "restore_pytree",
+    "capture_rng",
+    "restore_rng",
+    "load_rng",
+    "capture_key",
+    "restore_key",
+]

@@ -1,0 +1,172 @@
+"""Functional state capture: pytrees, numpy Generators, PRNG keys.
+
+The codebase keeps its data-path state functional (``FeatureCacheState``
+pytrees threaded through scans, ``TrainState`` NamedTuples, caller-owned
+``np.random.Generator`` objects), so the capture protocol has two halves:
+
+* **Stateful hosts objects** (loaders, the remote client) implement
+  ``state_dict() -> dict`` / ``load_state_dict(d)`` directly — the
+  torch-familiar spelling, returning plain dicts of scalars + arrays.
+* **Functional states** go through the free functions here:
+  :func:`capture_pytree` / :func:`restore_pytree` for any jax pytree
+  (TrainState, optimizer state, FeatureCacheState) and
+  :func:`capture_rng` / :func:`restore_rng` for numpy Generators.
+
+Restores are **bit-exact**: arrays round-trip through host numpy with
+their dtype preserved (exotic dtypes ride raw bytes — see
+``glt_tpu.ckpt.store``), and a Generator restored from its captured
+bit-generator state continues the identical stream.  Restore validates
+leaf count, shape, and dtype against a caller-supplied template of the
+same structure, so a checkpoint from a different model/config fails
+loudly instead of training on garbage.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from .store import CheckpointError
+
+_PYTREE_KIND = "pytree"
+_RNG_KIND = "np_generator"
+
+
+def capture_pytree(tree: Any) -> Dict[str, Any]:
+    """Snapshot any jax pytree as a serializable dict (host arrays).
+
+    This is a SYNC POINT: every device leaf is fetched to host.  Call it
+    at step boundaries (the epoch drivers' ``on_block``/``on_step``
+    hooks), never inside a jitted function.
+    """
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    host = [np.asarray(jax.device_get(leaf)) if _is_arrayish(leaf)
+            else leaf for leaf in leaves]
+    return {
+        "kind": _PYTREE_KIND,
+        "leaves": [_leaf_entry(leaf) for leaf in host],
+        # Debugging aid only — restore validates leaf-by-leaf against the
+        # template (treedef reprs are not stable across jax versions).
+        "structure": str(treedef),
+    }
+
+
+def _is_arrayish(leaf: Any) -> bool:
+    return hasattr(leaf, "dtype") and hasattr(leaf, "shape")
+
+
+def _leaf_entry(leaf: Any) -> Any:
+    if isinstance(leaf, np.ndarray):
+        return {"v": leaf}
+    if isinstance(leaf, (bool, int, float, str)) or leaf is None:
+        return {"v": leaf}
+    if isinstance(leaf, np.generic):
+        return {"v": leaf.item()}
+    raise CheckpointError(
+        f"pytree leaf of type {type(leaf).__name__} is not capturable")
+
+
+def restore_pytree(snapshot: Dict[str, Any], like: Any) -> Any:
+    """Rebuild a pytree captured by :func:`capture_pytree`.
+
+    ``like`` supplies the structure and per-leaf placement: jax-array
+    leaves come back as device arrays (``jnp.asarray``), numpy leaves as
+    numpy, Python scalars as their original type.  Leaf count / shape /
+    dtype mismatches raise :class:`~glt_tpu.ckpt.store.CheckpointError`
+    naming the offending leaf path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if snapshot.get("kind") != _PYTREE_KIND:
+        raise CheckpointError(
+            f"snapshot kind {snapshot.get('kind')!r} is not a pytree")
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(like)
+    saved = snapshot["leaves"]
+    if len(saved) != len(paths_leaves):
+        raise CheckpointError(
+            f"checkpoint has {len(saved)} pytree leaves, template has "
+            f"{len(paths_leaves)} — different model/optimizer config?")
+    out = []
+    for entry, (path, tmpl) in zip(saved, paths_leaves):
+        val = entry["v"]
+        if _is_arrayish(tmpl):
+            if not isinstance(val, np.ndarray):
+                val = np.asarray(val, dtype=np.asarray(tmpl).dtype)
+            if tuple(val.shape) != tuple(tmpl.shape) \
+                    or np.dtype(val.dtype) != np.dtype(tmpl.dtype):
+                raise CheckpointError(
+                    f"leaf {jax.tree_util.keystr(path)}: checkpoint "
+                    f"{val.dtype}{list(val.shape)} vs template "
+                    f"{np.dtype(tmpl.dtype)}{list(tmpl.shape)}")
+            out.append(jnp.asarray(val) if not isinstance(tmpl, np.ndarray)
+                       else val)
+        elif isinstance(tmpl, (bool, int, float, str)) or tmpl is None:
+            out.append(val if tmpl is None else type(tmpl)(val))
+        else:
+            raise CheckpointError(
+                f"template leaf {jax.tree_util.keystr(path)} of type "
+                f"{type(tmpl).__name__} is not restorable")
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def capture_rng(rng: np.random.Generator) -> Dict[str, Any]:
+    """Snapshot a numpy Generator (the loaders' / ``split_seeds``' rng).
+
+    The bit-generator state dict is JSON-able (Python ints carry the
+    128-bit PCG64 state exactly); restoring it continues the identical
+    stream — the property the bit-identical-resume contract rests on.
+    """
+    state = rng.bit_generator.state
+    return {"kind": _RNG_KIND, "state": _jsonify(state)}
+
+
+def restore_rng(snapshot: Dict[str, Any]) -> np.random.Generator:
+    """A fresh Generator continuing the captured stream."""
+    if snapshot.get("kind") != _RNG_KIND:
+        raise CheckpointError(
+            f"snapshot kind {snapshot.get('kind')!r} is not a Generator")
+    state = snapshot["state"]
+    name = state.get("bit_generator", "PCG64")
+    cls = getattr(np.random, name, None)
+    if cls is None:
+        raise CheckpointError(f"unknown bit generator {name!r}")
+    bg = cls()
+    bg.state = state
+    return np.random.Generator(bg)
+
+
+def load_rng(rng: np.random.Generator, snapshot: Dict[str, Any]) -> None:
+    """Restore a captured stream INTO an existing Generator (in place) —
+    for objects that hold their rng privately (loaders)."""
+    if snapshot.get("kind") != _RNG_KIND:
+        raise CheckpointError(
+            f"snapshot kind {snapshot.get('kind')!r} is not a Generator")
+    rng.bit_generator.state = snapshot["state"]
+
+
+def capture_key(key: Any) -> np.ndarray:
+    """jax PRNG key -> host array (fold_in/split reproduce exactly)."""
+    import jax
+
+    return np.asarray(jax.device_get(key))
+
+
+def restore_key(arr: Any) -> Any:
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.asarray(arr))
+
+
+def _jsonify(obj: Any) -> Any:
+    """bit_generator.state contains numpy ints/arrays; make it JSON-safe
+    while keeping exact values (Python ints are arbitrary precision)."""
+    if isinstance(obj, dict):
+        return {k: _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        return obj          # store layer serializes arrays losslessly
+    if isinstance(obj, np.generic):
+        return obj.item()
+    return obj

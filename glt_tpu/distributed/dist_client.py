@@ -406,6 +406,44 @@ class RemoteNeighborLoader:
     def __len__(self) -> int:
         return self.num_expected
 
+    # -- state-capture protocol (glt_tpu.ckpt) -----------------------------
+    def state_dict(self) -> dict:
+        """Per-producer epoch-fence + accounting state for checkpoints.
+
+        The durable facts a restarted client needs: its epoch fence (so
+        the resumed process's next epoch outranks every message the
+        killed process's epoch could still replay — the server discards
+        stale-epoch fetches), its ``client_key`` (a re-created producer
+        under the same key tears down the orphan server-side), and the
+        last completed epoch's seq accounting for the record.
+        """
+        return {
+            "epoch": int(self._epoch),
+            "client_key": self._client_key,
+            "num_expected": int(self.num_expected),
+            "last_epoch_stats": {
+                k: sorted(v) if isinstance(v, set) else v
+                for k, v in self.epoch_stats.items()},
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Resume the epoch fence in THIS (freshly constructed) loader.
+
+        The fence only ratchets forward: a fresh loader starts at 0, so
+        ``max`` keeps the restored fence above anything the interrupted
+        run produced — its next ``__iter__`` starts epoch ``saved + 1``
+        and the server's epoch check discards any in-flight replays of
+        the killed epoch (PR-4 fencing, composing with PR-4 replay).
+        """
+        saved = int(state["epoch"])
+        if saved != self._epoch and self.num_expected != int(
+                state.get("num_expected", self.num_expected)):
+            raise ValueError(
+                f"checkpoint was taken against a producer expecting "
+                f"{state.get('num_expected')} batches; this loader "
+                f"expects {self.num_expected} — different seed set?")
+        self._epoch = max(self._epoch, saved)
+
     def __iter__(self) -> Iterator[Batch]:
         self._epoch += 1
         epoch = self._epoch

@@ -437,8 +437,10 @@ class DistServer:
                  max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
                  reap_interval: float = 0.25,
                  fault_plan: Optional[FaultPlan] = None,
-                 enable_metrics: bool = False):
+                 enable_metrics: bool = False,
+                 heartbeat_deadline: float = 10.0):
         from .dist_context import _set_default, make_server_context
+        from .supervisor import Supervisor
 
         if enable_metrics:
             # Serving deployments opt in: flips the PROCESS-wide metrics
@@ -462,6 +464,12 @@ class DistServer:
         self.context = make_server_context(num_servers, server_rank,
                                            num_clients)
         _set_default(self.context)
+        # Fleet supervision (docs/distributed.md "Fleet supervision"):
+        # clients/trainers report liveness via the `heartbeat` op on this
+        # same control channel; `fleet_health` serves the structured
+        # table.  Monitoring starts lazily with the first beat, so
+        # heartbeat-free deployments pay nothing.
+        self.supervisor = Supervisor(deadline_secs=heartbeat_deadline)
         self._producers: Dict[int, _Producer] = {}
         # client_key -> producer id: a client that reconnects and
         # re-creates (its lease expired, or it restarted) first tears
@@ -575,6 +583,23 @@ class DistServer:
             _M_CREATED.inc()
             return {"producer_id": pid,
                     "num_expected": prod.num_expected()}
+        if op == "heartbeat":
+            # A fleet role reporting liveness (supervisor.HeartbeatSender).
+            # Also renews the peer's producer lease when it names one: a
+            # heartbeating client is an active client even between
+            # fetches (long eval pauses, slow trainers).
+            self.supervisor.beat(str(req.get("peer", "client")),
+                                 step=req.get("step"))
+            pid = req.get("producer_id")
+            if pid is not None:
+                with self._lock:
+                    prod = self._producers.get(pid)
+                if prod is not None:
+                    prod.touch()
+            return {"ok": True}
+        if op == "fleet_health":
+            return {"peers": self.supervisor.status(),
+                    "live_producers": self.live_producers()}
         if op == "get_metrics":
             # Prometheus-style text exposition (docs/observability.md):
             # a scrape sidecar (or a curl over the framed protocol) reads
@@ -715,7 +740,8 @@ def init_server(dataset, host: str = "127.0.0.1", port: int = 0,
                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
                 reap_interval: float = 0.25,
                 fault_plan: Optional[FaultPlan] = None,
-                enable_metrics: bool = False) -> DistServer:
+                enable_metrics: bool = False,
+                heartbeat_deadline: float = 10.0) -> DistServer:
     """Start a sampling server (cf. init_server, dist_server.py:158-190).
 
     Pass a picklable ``dataset_builder`` (+``builder_args``) to enable
@@ -739,4 +765,5 @@ def init_server(dataset, host: str = "127.0.0.1", port: int = 0,
                       max_frame_bytes=max_frame_bytes,
                       reap_interval=reap_interval,
                       fault_plan=fault_plan,
-                      enable_metrics=enable_metrics)
+                      enable_metrics=enable_metrics,
+                      heartbeat_deadline=heartbeat_deadline)

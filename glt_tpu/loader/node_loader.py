@@ -111,6 +111,31 @@ class NodeLoader:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
+    # -- state-capture protocol (glt_tpu.ckpt) -----------------------------
+    def state_dict(self) -> dict:
+        """Epoch cursor + shuffle-rng state, for durable checkpoints.
+
+        Restoring this into a freshly constructed loader (same seeds,
+        same config) makes its NEXT epoch's shuffle order identical to
+        what the captured loader would have drawn — the loader half of
+        the bit-identical-resume contract.  Covers every subclass
+        (Neighbor/Link/LinkNeighbor ride the same ``_rng``/``_epoch``).
+        """
+        from ..ckpt.state import capture_rng
+
+        return {
+            "epoch": int(self._epoch),
+            "rng": capture_rng(self._rng),
+            "overflow_batches": int(self.overflow_batches),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        from ..ckpt.state import load_rng
+
+        load_rng(self._rng, state["rng"])
+        self._epoch = int(state["epoch"])
+        self.overflow_batches = int(state.get("overflow_batches", 0))
+
     def _epoch_seed_batches(self) -> Iterator[np.ndarray]:
         ids = self.input_nodes
         if self.shuffle:

@@ -316,7 +316,8 @@ def make_pipelined_train_step(model, tx, sampler, rows, labels,
 
 
 def run_pipelined_epoch(step, sample_first, seed_batches, state,
-                        base_key, stats: dict = None) -> tuple:
+                        base_key, stats: dict = None,
+                        start_batch: int = 0, on_step=None) -> tuple:
     """Drive one epoch of the fused pipeline.
 
     ``seed_batches``: iterable of ``[batch_size]`` int32 device/host seed
@@ -324,6 +325,16 @@ def run_pipelined_epoch(step, sample_first, seed_batches, state,
     one per batch (every batch is trained exactly once; the final batch's
     train half runs in an epilogue step whose sample half re-samples batch
     0 and is discarded).
+
+    ``start_batch``/``on_step`` are the resume seam (glt_tpu.ckpt):
+    batch ``i`` always samples under ``fold_in(base_key, i)`` — pure in
+    its absolute position — so skipping the first ``start_batch``
+    batches of the same deterministic schedule replays the identical
+    remaining stream (the epilogue's re-sample half is discarded, so its
+    seed source moving from batch 0 to batch ``start_batch`` changes no
+    trained value).  ``on_step(state, i)`` fires after batch ``i``'s
+    train half DISPATCHES (unsynced — a checkpoint capture's own host
+    fetch is the sync; see ckpt.state.capture_pytree).
 
     ``stats``: optional dict; with an occupancy-capped sampler,
     ``stats['overflow_flags']`` collects each batch's device overflow
@@ -343,6 +354,8 @@ def run_pipelined_epoch(step, sample_first, seed_batches, state,
     first = None
     with _span("train.pipelined_epoch") as ep:
         for i, seeds in enumerate(seed_batches):
+            if i < start_batch:
+                continue
             seeds = jnp.asarray(seeds)
             k = jax.random.fold_in(base_key, i)
             if out is None:
@@ -356,6 +369,8 @@ def run_pipelined_epoch(step, sample_first, seed_batches, state,
             _M_STEPS.inc()
             losses.append(loss)
             accs.append(acc)
+            if on_step is not None:
+                on_step(state, i - 1)
         if out is not None:
             if flags is not None and out.metadata:
                 flags.append(out.metadata.get("overflow"))
@@ -366,6 +381,8 @@ def run_pipelined_epoch(step, sample_first, seed_batches, state,
             _M_STEPS.inc()
             losses.append(loss)
             accs.append(acc)
+            if on_step is not None:
+                on_step(state, start_batch + len(losses) - 1)
         if losses:
             # The epoch span closes on real device completion, not on the
             # dispatch of the last enqueue (bench.py:33 tunnel caveat).
@@ -473,6 +490,14 @@ def make_scanned_node_train_step(model, tx, sampler, rows, labels,
         return state, losses, accs, ovfs
 
     step.feature_cache = lambda: cache_holder["cache"]
+
+    def _set_feature_cache(new_cache):
+        # Checkpoint-restore seam (glt_tpu.ckpt): the cross-block cache
+        # rides the closure, so a resumed run pushes the captured
+        # FeatureCacheState back in here before its first block.
+        cache_holder["cache"] = new_cache
+
+    step.set_feature_cache = _set_feature_cache
     return step
 
 
@@ -491,7 +516,8 @@ def node_seed_blocks(train_idx, batch_size: int, group: int, rng):
 
 
 def run_scanned_epoch(step, state, train_idx, batch_size: int,
-                      group: int, rng, base_key):
+                      group: int, rng, base_key, start_block: int = 0,
+                      on_block=None):
     """One epoch through a scanned train step (node or hetero variant).
 
     Shuffles ``train_idx`` into ``[G, B]`` blocks, pre-stages them to
@@ -502,15 +528,31 @@ def run_scanned_epoch(step, state, train_idx, batch_size: int,
     overflow_count)`` as host numpy (the fetch is the epoch's sync
     point); ``overflow_count`` is 0 for steps without an overflow
     channel.
+
+    ``start_block``/``on_block`` are the resume seam
+    (:class:`~glt_tpu.ckpt.driver.TrainLoop`): the first ``start_block``
+    blocks are skipped WITHOUT disturbing the key schedule — block ``i``
+    always trains under ``fold_in(base_key, i)``, a pure function of its
+    position — so an epoch resumed mid-way replays the identical
+    remaining batch stream.  ``on_block(state, block_idx)`` fires after
+    each block completes (checkpoint cadence, supervisor polls, fault
+    hooks); it forces the block's device work to finish first, so state
+    captured inside the hook is the exact post-block state.
     """
     import numpy as np
 
     blocks = [jax.device_put(jnp.asarray(b.astype(np.int32)))
               for b in node_seed_blocks(train_idx, batch_size, group, rng)]
     n_real = -(-len(train_idx) // batch_size)
+    # Real batches already consumed before the resume point: the loss
+    # trim below only accounts for the blocks this call actually runs.
+    n_real = max(0, n_real - int(start_block) * group)
     losses, accs, ovfs = [], [], []
-    with _span("train.scanned_epoch", blocks=len(blocks)):
+    with _span("train.scanned_epoch", blocks=len(blocks),
+               start_block=int(start_block)):
         for i, blk in enumerate(blocks):
+            if i < start_block:
+                continue
             with _span("train.scanned_block_dispatch"):
                 res = step(state, blk, jax.random.fold_in(base_key, i))
             _M_STEPS.inc()
@@ -519,12 +561,22 @@ def run_scanned_epoch(step, state, train_idx, batch_size: int,
             accs.append(res[2])
             if len(res) > 3:
                 ovfs.append(res[3])
+            if on_block is not None:
+                # The hook may checkpoint: block on this block's update
+                # first so the captured TrainState is post-block exact
+                # (dispatch is async; a capture of an in-flight state
+                # would still be *correct* — device_get syncs — but the
+                # explicit wait keeps save timing honest in traces).
+                jax.block_until_ready(state)
+                on_block(state, i)
         _M_EPOCHS.inc()
         # The epoch's own host fetch below is the sync; the span closes
         # around it so the scanned epoch's trace duration is truthful.
-        losses = np.asarray(jax.device_get(
-            jnp.concatenate(losses)))[:n_real]
-    accs = np.asarray(jax.device_get(jnp.concatenate(accs)))[:n_real]
+        losses = (np.asarray(jax.device_get(
+            jnp.concatenate(losses)))[:n_real] if losses
+            else np.zeros((0,), np.float32))
+    accs = (np.asarray(jax.device_get(jnp.concatenate(accs)))[:n_real]
+            if accs else np.zeros((0,), np.float32))
     ovf = (int(np.asarray(jax.device_get(
         jnp.concatenate(ovfs))).sum()) if ovfs else 0)
     return state, losses, accs, ovf

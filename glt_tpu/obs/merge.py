@@ -33,6 +33,7 @@ with no direct samples against the reference still align.
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 
@@ -203,8 +204,12 @@ def merge_traces(paths: Sequence[str], out: Optional[str] = None,
         },
     }
     if out is not None:
-        with open(out, "w") as fh:
+        # Atomic publish (GLT011): the merged trace is read by Perfetto /
+        # the CLI while a re-merge may be running over the same path.
+        tmp = f"{out}.tmp-{os.getpid()}"
+        with open(tmp, "w") as fh:
             json.dump(result, fh)
+        os.replace(tmp, out)
     return result
 
 

@@ -294,9 +294,19 @@ class Tracer:
         return out
 
     def export(self, path: str) -> str:
-        """Write the Chrome-trace JSON to ``path``; returns ``path``."""
-        with open(path, "w") as f:
+        """Write the Chrome-trace JSON to ``path``; returns ``path``.
+
+        Atomic (tmp + ``os.replace``, the checkpoint-store publish
+        discipline): a process killed mid-export — exactly the moment
+        the crash-time flush runs — leaves the previous complete export
+        or none, never a torn JSON that ``obs merge`` chokes on.
+        """
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(self.chrome_trace(), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
         return path
 
 
@@ -340,6 +350,10 @@ def auto_trace(role: str) -> Optional[str]:
     path this process should export to at teardown; otherwise return
     ``None`` and touch nothing.  Callers hold the path and call
     :func:`auto_trace_export` when the role shuts down.
+
+    Registration also arms the crash-time flush: the first registered
+    path installs ``atexit`` + SIGTERM handlers so a killed/preempted
+    process still exports its partial trace (see :func:`flush_exports`).
     """
     trace_dir = os.environ.get(TRACE_DIR_ENV)
     if not trace_dir:
@@ -349,7 +363,11 @@ def auto_trace(role: str) -> Optional[str]:
         tracer = start_trace(process_name=role)
     elif tracer.process_name is None:
         tracer.process_name = role
-    return os.path.join(trace_dir, f"trace-{role}-{os.getpid()}.json")
+    path = os.path.join(trace_dir, f"trace-{role}-{os.getpid()}.json")
+    with _flush_lock:
+        _flush_paths.add(path)
+    _install_crash_handlers()
+    return path
 
 
 def auto_trace_export(path: Optional[str]) -> Optional[str]:
@@ -369,6 +387,82 @@ def stop_trace(path: Optional[str] = None) -> Optional[Tracer]:
     if tracer is not None and path is not None:
         tracer.export(path)
     return tracer
+
+
+# -- crash-time flush -------------------------------------------------------
+#
+# A preempted/killed fleet process used to lose its spans: the export
+# only ran on the role's orderly shutdown path.  Registering a path via
+# auto_trace() now arms a one-time atexit + SIGTERM flush, so normal
+# interpreter exit AND the polite half of preemption (SIGTERM before the
+# SIGKILL grace deadline) both export the partial trace.  SIGKILL itself
+# is unflushable by definition — nothing user-space runs — which is why
+# the supervisor's PEER-side spans (`supervisor.peer_dead` instants, the
+# surviving roles' traces) are the record of a hard-killed process; see
+# docs/distributed.md "Fleet supervision".
+
+_flush_lock = threading.Lock()
+_flush_paths: set = set()
+_handlers_installed = False
+
+
+def flush_exports(reason: Optional[str] = None) -> List[str]:
+    """Export the global tracer to every auto-trace-registered path NOW.
+
+    Idempotent and crash-ordered: exports are atomic (tmp + replace), so
+    repeated flushes (supervisor exit path, then atexit) each publish a
+    complete snapshot.  ``reason`` is stamped as a ``trace.flush``
+    instant so a flushed-early trace is self-describing.  Returns the
+    written paths ([] when tracing is off or nothing registered).
+    """
+    tracer = _current
+    with _flush_lock:
+        paths = sorted(_flush_paths)
+    if tracer is None or not paths:
+        return []
+    if reason is not None:
+        tracer.instant("trace.flush", reason=str(reason))
+    written = []
+    for path in paths:
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            written.append(tracer.export(path))
+        except OSError:
+            continue    # a dead disk must not mask the original exit
+    return written
+
+
+def _install_crash_handlers() -> None:
+    """Arm atexit + SIGTERM flush, once per process.
+
+    The SIGTERM handler flushes, restores the previous disposition, and
+    re-raises the signal against this process — so exit status, parent
+    supervisors, and any chained handler all observe the genuine signal
+    death, with the trace already on disk.  Installed lazily from
+    :func:`auto_trace` (import must stay side-effect free); non-main
+    threads skip the signal half (Python restricts ``signal.signal`` to
+    the main thread — the atexit half still covers orderly exits).
+    """
+    global _handlers_installed
+    with _flush_lock:
+        if _handlers_installed:
+            return
+        _handlers_installed = True
+    import atexit
+    import signal as _signal
+
+    atexit.register(flush_exports)
+
+    def _on_sigterm(signum, frame):
+        flush_exports(reason="sigterm")
+        _signal.signal(signum, prev if callable(prev) else
+                       _signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+    try:
+        prev = _signal.signal(_signal.SIGTERM, _on_sigterm)
+    except ValueError:      # not the main thread: atexit-only coverage
+        pass
 
 
 def span(name: str, **attrs):

@@ -365,20 +365,46 @@ class _ColdStagePipeline:
             self.dropped_total += total
         return self.dropped_total
 
-    def run_epoch(self, state: TrainState, seed_batches, key: jax.Array):
+    def run_epoch(self, state: TrainState, seed_batches, key: jax.Array,
+                  start_batch: int = 0, on_batch=None, supervisor=None):
         """Drive one epoch; ``seed_batches``: iterable of ``[S, B]`` seeds.
 
         Returns ``(state, losses, accs)`` (device scalars, unsynced).
         Check ``flush_dropped()`` after the epoch: nonzero means some
         cold requests overflowed the staging capacity and trained on
         zero rows.
+
+        Preemption-safety seam (glt_tpu.ckpt): batch ``i`` always trains
+        under keys folded from its absolute position, so resuming with
+        ``start_batch=k`` (skipping the first ``k`` batches of a
+        deterministic ``split_seeds`` schedule — thread the SAME
+        epoch-rng state you checkpointed) replays the identical
+        remaining stream.  ``on_batch(state, i)`` fires after each
+        trained batch, synced — the checkpoint-cadence hook.
+        ``supervisor`` (a :class:`~glt_tpu.distributed.supervisor.
+        Supervisor`) is polled at the same boundary; a dead peer raises
+        its structured :class:`~glt_tpu.distributed.supervisor.
+        PeerDeadError` out of this loop for the caller's
+        checkpoint-and-exit.
         """
         from . import multihost
 
         losses, accs = [], []
-        pending = None  # (out, cold future)
+        pending = None  # (idx, out, cold future)
         n = 0
+
+        def trained(i, state):
+            if on_batch is None and supervisor is None:
+                return
+            jax.block_until_ready(state)
+            if on_batch is not None:
+                on_batch(state, i)
+            if supervisor is not None:
+                supervisor.raise_if_dead()
+
         for i, seeds in enumerate(seed_batches):
+            if i < start_batch:
+                continue
             kb = jax.random.fold_in(key, i)
             if not isinstance(seeds, jax.Array):
                 # Per-host feed: every process holds the full [S, B] host
@@ -390,18 +416,20 @@ class _ColdStagePipeline:
             fut = self._stage_cold_async(out)
             if pending is not None:
                 state, loss, acc = self.train_step(
-                    state, pending[0], pending[1].result(),
+                    state, pending[1], pending[2].result(),
                     jax.random.fold_in(kb, 2))
                 losses.append(loss)
                 accs.append(acc)
-            pending = (out, fut)
+                trained(pending[0], state)
+            pending = (i, out, fut)
             n = i + 1
         if pending is not None:
             state, loss, acc = self.train_step(
-                state, pending[0], pending[1].result(),
+                state, pending[1], pending[2].result(),
                 jax.random.fold_in(jax.random.fold_in(key, n), 2))
             losses.append(loss)
             accs.append(acc)
+            trained(pending[0], state)
         return state, losses, accs
 
     def close(self) -> None:

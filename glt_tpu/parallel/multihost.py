@@ -115,6 +115,65 @@ def shutdown() -> None:
         jax.distributed.shutdown()
 
 
+# -- deadline-bounded collectives ------------------------------------------
+#
+# The characteristic multihost failure mode is the forever-hang: one
+# preempted host leaves every surviving peer blocked inside a collective
+# with no exception and no timeout.  With GLT_MULTIHOST_TIMEOUT_S set,
+# every host-side collective in this module runs under the supervisor's
+# deadline wrapper and a dead/straggling peer surfaces as a structured
+# BarrierTimeoutError the training loop converts into a
+# checkpoint-and-exit (docs/distributed.md "Fleet supervision").  Unset
+# (the default), behavior is exactly as before — zero wrapper overhead.
+
+#: Env var: seconds a multihost barrier/collective may block before a
+#: structured BarrierTimeoutError; 0/unset = unbounded (legacy).
+TIMEOUT_ENV = "GLT_MULTIHOST_TIMEOUT_S"
+
+
+def collective_deadline_secs() -> float:
+    """The configured collective deadline (0.0 = unbounded)."""
+    try:
+        return float(os.environ.get(TIMEOUT_ENV, "0") or 0.0)
+    except ValueError:
+        return 0.0
+
+
+def _bounded(fn, what: str):
+    """Run a host-side collective under the configured deadline."""
+    deadline = collective_deadline_secs()
+    if deadline <= 0:
+        return fn()
+    from ..distributed.supervisor import run_with_deadline
+
+    return run_with_deadline(fn, deadline, what=what)
+
+
+def barrier(name: str, timeout_s: Optional[float] = None) -> None:
+    """A named cross-process barrier that cannot hang forever.
+
+    Single-process: immediate no-op.  Fleet: ``sync_global_devices``
+    under ``timeout_s`` (default: the :data:`TIMEOUT_ENV` deadline;
+    unbounded when neither is set).  Raises
+    :class:`~glt_tpu.distributed.supervisor.BarrierTimeoutError` on
+    expiry — the caller checkpoints and exits (TrainLoop does both).
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    def sync():
+        multihost_utils.sync_global_devices(name)
+
+    if timeout_s is None:
+        _bounded(sync, what=f"barrier {name!r}")
+    else:
+        from ..distributed.supervisor import run_with_deadline
+
+        run_with_deadline(sync, float(timeout_s),
+                          what=f"barrier {name!r}")
+
+
 def global_mesh(axis_name: str = "shard") -> Mesh:
     """One-axis mesh over every device of every process.
 
@@ -172,8 +231,10 @@ def agree_max(value: int) -> int:
         return int(value)
     from jax.experimental import multihost_utils
 
-    all_vals = multihost_utils.process_allgather(
-        np.asarray([value], np.int64))
+    all_vals = _bounded(
+        lambda: multihost_utils.process_allgather(
+            np.asarray([value], np.int64)),
+        what="agree_max allgather")
     return int(np.max(all_vals))
 
 
@@ -190,7 +251,9 @@ def agree_sum(arr: np.ndarray) -> np.ndarray:
         return arr
     from jax.experimental import multihost_utils
 
-    return np.sum(multihost_utils.process_allgather(arr), axis=0)
+    return np.sum(_bounded(
+        lambda: multihost_utils.process_allgather(arr),
+        what="agree_sum allgather"), axis=0)
 
 
 # -- per-host sharded construction ----------------------------------------

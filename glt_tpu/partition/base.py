@@ -97,7 +97,12 @@ class PartitionerBase(ABC):
         np.save(os.path.join(self.output_dir, "edge_pb.npy"), edge_pb)
         np.save(os.path.join(self.output_dir, "node_feat_pb.npy"),
                 node_feat_pb)
-        with open(os.path.join(self.output_dir, "META.json"), "w") as fh:
+        # META.json is the partition set's read gate (loaders open it
+        # first): publish atomically so a loader racing the partitioner
+        # sees either no partition set or a complete one (GLT011).
+        meta_path = os.path.join(self.output_dir, "META.json")
+        meta_tmp = f"{meta_path}.tmp-{os.getpid()}"
+        with open(meta_tmp, "w") as fh:
             json.dump({
                 "num_parts": self.num_parts,
                 "num_nodes": self.num_nodes,
@@ -106,6 +111,7 @@ class PartitionerBase(ABC):
                 "with_node_feat": self.node_feat is not None,
                 "with_edge_feat": self.edge_feat is not None,
             }, fh)
+        os.replace(meta_tmp, meta_path)
 
         for p in range(self.num_parts):
             pdir = os.path.join(self.output_dir, f"part{p}")

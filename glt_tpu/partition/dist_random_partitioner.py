@@ -141,7 +141,11 @@ class DistRandomPartitioner:
                 f"partition_rank_chunk before finalize")
         np.save(os.path.join(self.output_dir, "edge_pb.npy"), edge_pb)
         np.save(os.path.join(self.output_dir, "node_feat_pb.npy"), node_pb)
-        with open(os.path.join(self.output_dir, "META.json"), "w") as fh:
+        # Atomic META publish, matching partition/base.py (GLT011): the
+        # META write is the "partition set complete" commit point.
+        meta_path = os.path.join(self.output_dir, "META.json")
+        meta_tmp = f"{meta_path}.tmp-{os.getpid()}"
+        with open(meta_tmp, "w") as fh:
             json.dump({
                 "num_parts": self.num_parts,
                 "num_nodes": self.num_nodes,
@@ -150,6 +154,7 @@ class DistRandomPartitioner:
                 "with_node_feat": with_node_feat,
                 "with_edge_feat": False,
             }, fh)
+        os.replace(meta_tmp, meta_path)
         # clean spill dirs
         for r in ranks:
             d = self._spill_dir(r)

@@ -18,6 +18,8 @@ racing the scheduler, no flaky "usually drops around batch 3".
 from __future__ import annotations
 
 import dataclasses
+import os
+import signal
 import socket
 import struct
 import threading
@@ -36,6 +38,19 @@ class ProducerKilled(BaseException):
     ``except Exception`` must NOT turn this into a clean error message —
     the thread has to die the way a real crash kills it (no relay, no
     cleanup), so the fetch path's liveness recheck is what surfaces it.
+    """
+
+
+class SimulatedPreemption(BaseException):
+    """In-process stand-in for a SIGKILL at a train-step boundary.
+
+    A ``BaseException`` for the same reason as :class:`ProducerKilled`:
+    no ``except Exception`` recovery path may see it — the training
+    process is "gone" from this point, and only a from-scratch rebuild +
+    ``resume()`` (tests/test_checkpoint.py) continues the run.  The
+    real-signal variant (``kill_at_train_step``) SIGKILLs the actual
+    process; this one exists so the kill-at-every-k sweep can run in one
+    pytest process.
     """
 
 
@@ -67,6 +82,15 @@ class FaultPlan:
     # Kill the server-side producer epoch thread after this many buffer
     # puts (via ProducerKilled, so it dies unrelayed).
     kill_producer_after_puts: Optional[int] = None
+    # SIGKILL THIS PROCESS after its Nth completed train step (1-based;
+    # the ckpt.driver.TrainLoop fires on_train_step once per block,
+    # after any due checkpoint save) — the chaos suite's counter-exact
+    # preemption point.  SIGKILL is unhandleable by design: no atexit,
+    # no flush, exactly what a preempted TPU host looks like.
+    kill_at_train_step: Optional[int] = None
+    # Same point, but raise SimulatedPreemption instead of dying — the
+    # in-process variant for the kill-at-every-k resume sweep.
+    preempt_at_train_step: Optional[int] = None
     # Only the first N accepted/established connections are faulty;
     # later ones run clean (lets a test end the weather deterministically).
     max_faulty_conns: Optional[int] = None
@@ -76,10 +100,12 @@ class FaultPlan:
         self._frames_total = 0
         self._conns = 0
         self._puts = 0
+        self._train_steps = 0
         self.injected_drops = 0
         self.injected_failures = 0
         self.injected_corruptions = 0
         self.injected_delays = 0
+        self.injected_preemptions = 0
 
     # -- endpoint hooks ----------------------------------------------------
     def wrap(self, sock: socket.socket):
@@ -103,6 +129,28 @@ class FaultPlan:
             raise ProducerKilled(
                 f"fault injection: producer thread killed after "
                 f"{self.kill_producer_after_puts} puts")
+
+    def on_train_step(self) -> None:
+        """Called by the training loop after each completed step/block
+        (and after any checkpoint due at that step) — the counter-exact
+        preemption point for ``kill_at_train_step`` /
+        ``preempt_at_train_step``."""
+        if (self.kill_at_train_step is None
+                and self.preempt_at_train_step is None):
+            return
+        with self._lock:
+            self._train_steps += 1
+            n = self._train_steps
+        if self.kill_at_train_step is not None \
+                and n == self.kill_at_train_step:
+            os.kill(os.getpid(), signal.SIGKILL)   # never returns
+        if self.preempt_at_train_step is not None \
+                and n == self.preempt_at_train_step:
+            with self._lock:
+                self.injected_preemptions += 1
+            raise SimulatedPreemption(
+                f"fault injection: process preempted after {n} "
+                f"train steps")
 
     @property
     def connections(self) -> int:

@@ -1,9 +1,17 @@
-"""Checkpoint / resume for training state.
+"""Checkpoint / resume for training state (legacy orbax wrapper).
 
 The reference leaves checkpointing to user PyTorch code (SURVEY §5:
 absent from the library); a complete TPU framework ships it: orbax-backed
 save/restore of the :class:`~glt_tpu.models.train.TrainState` pytree plus
 loader epoch/step bookkeeping, so long runs resume exactly.
+
+.. note:: Prefer :mod:`glt_tpu.ckpt` — the engine-native, dependency-free
+   checkpoint layer: atomic manifest+checksum store, whole-data-path
+   capture (loader cursors, rng, feature cache, remote-client fences —
+   not just the model pytree), corruption fallback, and the
+   bit-identical-resume contract chaos-tested in
+   tests/test_checkpoint.py.  This module survives for users already on
+   orbax directories (``pip install glt-tpu[checkpoint]``).
 """
 from __future__ import annotations
 

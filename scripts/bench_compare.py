@@ -75,12 +75,18 @@ def main(argv=None) -> int:
     report = compare(runs, rel_tol=args.rel_tol, noise_k=args.noise_k)
     md = markdown_report(report)
     print(md)
+    # Atomic publishes (GLT011): CI uploads these as artifacts while the
+    # job may still be appending — a torn report reads as a clean pass.
     if args.out:
-        with open(args.out, "w") as f:
+        tmp = f"{args.out}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
             f.write(md + "\n")
+        os.replace(tmp, args.out)
     if args.json_out:
-        with open(args.json_out, "w") as f:
+        tmp = f"{args.json_out}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(report, f, indent=2)
+        os.replace(tmp, args.json_out)
     if args.strict and report["regressions"]:
         return 1
     return 0

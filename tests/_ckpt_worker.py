@@ -1,0 +1,104 @@
+"""Preemption-chaos worker: one (possibly SIGKILLed) training process.
+
+Launched as a plain subprocess by tests/test_checkpoint.py:
+
+    python tests/_ckpt_worker.py <ckpt_root> <result_json> [kill_at]
+
+Builds the SAME deterministic cluster-graph training run every
+invocation (seeds are literals below) and drives it through
+:class:`glt_tpu.ckpt.TrainLoop` with checkpoint-every-step.  With
+``kill_at`` the process SIGKILLs ITSELF after that global step via
+:class:`~glt_tpu.testing.faults.FaultPlan` — a real, unhandleable kill:
+no atexit, no flush, the honest preemption.  Without it the worker
+resumes from whatever checkpoint the previous (killed) invocation
+published, runs to completion, and writes ``result_json``
+(atomically) with the post-resume losses and a bit-exact param digest.
+
+The parent compares that digest + loss stream against an uninterrupted
+in-process run of the identical schedule: SIGKILL anywhere, resume,
+bit-identical — the tentpole contract of glt_tpu.ckpt.
+"""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EPOCHS = 2
+BATCH = 16
+GROUP = 2
+SEEDS = 40
+
+
+def build_loop(ckpt_root, kill_at=None):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from glt_tpu.ckpt import Checkpointer, TrainLoop
+    from glt_tpu.models import TrainState
+    from glt_tpu.models.sage import GraphSAGE
+    from glt_tpu.models.train import make_scanned_node_train_step
+    from glt_tpu.sampler import NeighborSampler
+    from glt_tpu.testing.faults import FaultPlan
+    from tests.test_models import _cluster_dataset
+
+    ds, labels = _cluster_dataset()
+    model = GraphSAGE(hidden_features=16, out_features=3, num_layers=2,
+                      dropout_rate=0.0)
+    tx = optax.adam(1e-2)
+    sampler = NeighborSampler(ds.get_graph(), [4, 4], batch_size=BATCH,
+                              with_edge=False)
+    feat = ds.get_node_feature()
+    x0 = jnp.zeros((sampler.node_capacity, feat.shape[1]), jnp.float32)
+    ei0 = jnp.full((2, sampler.edge_capacity), -1, jnp.int32)
+    m0 = jnp.zeros((sampler.edge_capacity,), bool)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
+    state = TrainState(params=params, opt_state=tx.init(params),
+                       step=jnp.zeros((), jnp.int32))
+    step = make_scanned_node_train_step(model, tx, sampler, feat, labels,
+                                        BATCH)
+    plan = (FaultPlan(kill_at_train_step=int(kill_at))
+            if kill_at is not None else None)
+    return TrainLoop(
+        step, state, np.arange(SEEDS), BATCH, GROUP, epochs=EPOCHS,
+        rng=np.random.default_rng(7), base_key=jax.random.PRNGKey(3),
+        checkpointer=Checkpointer(ckpt_root, every_n_steps=1, keep=3),
+        fault_plan=plan)
+
+
+def param_digest(state):
+    import hashlib
+
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        h.update(np.asarray(jax.device_get(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def main() -> int:
+    ckpt_root, result_json = sys.argv[1], sys.argv[2]
+    kill_at = sys.argv[3] if len(sys.argv) > 3 else None
+    loop = build_loop(ckpt_root, kill_at=kill_at)
+    snap = loop.resume()
+    state = loop.run()   # a kill_at run dies in here, mid-epoch
+    out = {
+        "resumed_from": None if snap is None else snap.step,
+        "start_step": loop.start_step,
+        "losses": loop.losses,
+        "param_digest": param_digest(state),
+    }
+    tmp = f"{result_json}.tmp-{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(out, fh)
+    os.replace(tmp, result_json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -631,6 +631,89 @@ class TestSpanInTracedCode:
 
 
 # ---------------------------------------------------------------------------
+# GLT011 non-atomic-state-publish
+# ---------------------------------------------------------------------------
+
+class TestNonAtomicStatePublish:
+    def test_positive_direct_final_path_write(self):
+        src = """
+        import json
+
+        def save_manifest(path, obj):
+            with open(path, "w") as fh:
+                json.dump(obj, fh)
+        """
+        fs = findings_for(src, "non-atomic-state-publish")
+        assert len(fs) == 1 and "os.replace" in fs[0].message
+
+    def test_positive_mode_keyword_and_append(self):
+        src = """
+        def log_artifact(report_path, line):
+            with open(report_path, mode="a") as fh:
+                fh.write(line)
+        """
+        assert len(findings_for(src, "non-atomic-state-publish")) == 1
+
+    def test_positive_module_level_write(self):
+        src = """
+        import json
+        with open("artifacts/results.json", "w") as fh:
+            json.dump({}, fh)
+        """
+        assert len(findings_for(src, "non-atomic-state-publish")) == 1
+
+    def test_negative_tmp_plus_replace(self):
+        # The glt_tpu.ckpt.store discipline: private tmp, one rename.
+        src = """
+        import json
+        import os
+
+        def publish(path, obj):
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(obj, fh)
+            os.replace(tmp, path)
+        """
+        assert findings_for(src, "non-atomic-state-publish") == []
+
+    def test_negative_tmp_named_path_without_replace(self):
+        # A visibly process-private scratch file needs no publish step.
+        src = """
+        def scratch(obj):
+            with open("/tmp/debug-dump.txt", "w") as fh:
+                fh.write(str(obj))
+        """
+        assert findings_for(src, "non-atomic-state-publish") == []
+
+    def test_negative_read_mode_untouched(self):
+        src = """
+        import json
+
+        def load(path):
+            with open(path) as fh:
+                return json.load(fh)
+
+        def load_binary(path):
+            with open(path, "rb") as fh:
+                return fh.read()
+        """
+        assert findings_for(src, "non-atomic-state-publish") == []
+
+    def test_negative_shutil_move_publish(self):
+        src = """
+        import shutil
+        import tempfile
+
+        def publish(path, text):
+            fd, tmp = tempfile.mkstemp()
+            with open(tmp, "w") as fh:
+                fh.write(text)
+            shutil.move(tmp, path)
+        """
+        assert findings_for(src, "non-atomic-state-publish") == []
+
+
+# ---------------------------------------------------------------------------
 # the project engine: symbols, call graph, effects
 # ---------------------------------------------------------------------------
 
@@ -1240,7 +1323,7 @@ def test_rule_registry_complete():
         "int64-id-truncation", "nondeterministic-default-rng",
         "shadowed-jit-donation", "unbounded-blocking-get",
         "lock-order-inversion", "blocking-call-while-holding-lock",
-        "span-in-traced-code",
+        "span-in-traced-code", "non-atomic-state-publish",
     }
 
 

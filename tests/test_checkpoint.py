@@ -1,0 +1,837 @@
+"""Chaos suite for glt_tpu.ckpt + the fleet supervisor (ISSUE 8).
+
+The tentpole contract under test: **bit-identical resume** — kill the
+training process at ANY step boundary (simulated preemption at every k
+in-process; a real SIGKILL in the slow subprocess test), resume from the
+last published checkpoint in a from-scratch process, and the remaining
+batch stream, per-batch losses, and final parameter bits all match an
+uninterrupted run exactly.  No retry slop, no "close enough".
+
+Plus: the atomic manifest+checksum store (torn tmp dirs ignored,
+corruption falls back a step), the per-component state_dict protocol
+(loaders, remote client), the heartbeat supervisor (dead peers detected
+within the deadline; runs end with a checkpoint + structured reason —
+never a hang), and the composition with PR-4 remote-sampling replay.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from glt_tpu.ckpt import (
+    Checkpointer,
+    CheckpointCorruptError,
+    CheckpointError,
+    TrainLoop,
+    capture_pytree,
+    capture_rng,
+    latest_step,
+    list_steps,
+    load_rng,
+    read_checkpoint,
+    restore_pytree,
+    restore_rng,
+    write_checkpoint,
+)
+from glt_tpu.ckpt import store as ckpt_store
+from glt_tpu.models import TrainState
+from glt_tpu.models.sage import GraphSAGE
+from glt_tpu.models.train import make_scanned_node_train_step
+from glt_tpu.sampler import NeighborSampler
+from glt_tpu.testing.faults import FaultPlan, SimulatedPreemption
+from tests.test_models import _cluster_dataset
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BATCH, GROUP, EPOCHS, SEEDS = 16, 2, 2, 40
+# 3 real batches/epoch -> 2 blocks/epoch -> 4 global steps over 2 epochs.
+TOTAL_STEPS = 4
+
+
+# ---------------------------------------------------------------------------
+# store: atomic publish, checksums, fallback
+# ---------------------------------------------------------------------------
+
+def test_store_roundtrip(tmp_path):
+    root = str(tmp_path)
+    comps = {
+        "a": {"x": np.arange(6, dtype=np.int64).reshape(2, 3),
+              "nested": {"f": 1.5, "s": "hi", "n": None, "b": True},
+              "lst": [1, 2, np.float32(3.5)]},
+        "b": {"arr": np.linspace(0, 1, 5, dtype=np.float32)},
+    }
+    path = write_checkpoint(root, 7, comps, extras={"why": "test"})
+    assert os.path.isdir(path)
+    step, got, extras = read_checkpoint(root)
+    assert step == 7 and extras == {"why": "test"}
+    np.testing.assert_array_equal(got["a"]["x"], comps["a"]["x"])
+    assert got["a"]["nested"] == {"f": 1.5, "s": "hi", "n": None, "b": True}
+    assert got["a"]["lst"][2] == 3.5
+    np.testing.assert_array_equal(got["b"]["arr"], comps["b"]["arr"])
+
+
+def test_store_exotic_dtype_bit_exact(tmp_path):
+    """bfloat16 (not npz-native) rides raw bytes + dtype tag, bit-exact."""
+    root = str(tmp_path)
+    arr = jnp.asarray(np.random.default_rng(0).normal(size=(4, 3)),
+                      jnp.bfloat16)
+    write_checkpoint(root, 1, {"m": {"w": arr}})
+    _, got, _ = read_checkpoint(root)
+    back = got["m"]["w"]
+    assert str(back.dtype) == "bfloat16" and back.shape == (4, 3)
+    assert np.asarray(jnp.asarray(back).view(jnp.uint16) ==
+                      arr.view(jnp.uint16)).all()
+
+
+def test_store_latest_pointer_and_fallback(tmp_path):
+    root = str(tmp_path)
+    write_checkpoint(root, 1, {"c": {"v": 1}})
+    write_checkpoint(root, 2, {"c": {"v": 2}})
+    assert latest_step(root) == 2
+    # Pointer write lost (crash between dir publish and pointer publish):
+    # the newest published dir still wins.
+    os.remove(os.path.join(root, "LATEST"))
+    assert latest_step(root) == 2
+    assert list_steps(root) == [1, 2]
+
+
+def test_store_ignores_and_sweeps_tmp_leftovers(tmp_path):
+    root = str(tmp_path)
+    write_checkpoint(root, 3, {"c": {"v": 3}})
+    # A writer SIGKILLed mid-save leaves only a private .tmp- dir.
+    torn = os.path.join(root, ".tmp-step_00000009-12345")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "manifest.json"), "w") as fh:
+        fh.write("{ torn")
+    assert list_steps(root) == [3]
+    assert latest_step(root) == 3
+    # Fresh tmp dirs survive the sweep (a concurrent writer may own
+    # them); backdated ones are collected.
+    assert ckpt_store.sweep_tmp(root) == 0
+    old = time.time() - 120
+    os.utime(torn, (old, old))
+    assert ckpt_store.sweep_tmp(root) == 1
+    assert not os.path.exists(torn)
+
+
+def test_store_corruption_detected_and_resume_falls_back(tmp_path):
+    root = str(tmp_path)
+    write_checkpoint(root, 1, {"c": {"v": np.arange(4)}})
+    write_checkpoint(root, 2, {"c": {"v": np.arange(8)}})
+    # Bit-rot the newest arrays file AFTER publish.
+    with open(os.path.join(root, "step_00000002", "arrays.npz"),
+              "r+b") as fh:
+        fh.seek(30)
+        fh.write(b"\xff\xff")
+    with pytest.raises(CheckpointCorruptError):
+        read_checkpoint(root, 2)
+    snap = Checkpointer(root).resume()
+    assert snap.step == 1          # fell back past the corrupt step
+    np.testing.assert_array_equal(snap.components["c"]["v"], np.arange(4))
+
+
+def test_store_prune_never_drops_latest(tmp_path):
+    root = str(tmp_path)
+    for s in (1, 2, 3, 4):
+        write_checkpoint(root, s, {"c": {"v": s}})
+    removed = ckpt_store.prune(root, keep=2)
+    assert removed == [1, 2]
+    assert list_steps(root) == [3, 4] and latest_step(root) == 4
+
+
+def test_store_rejects_reserved_key_and_bad_leaf(tmp_path):
+    with pytest.raises(CheckpointError, match="reserved"):
+        write_checkpoint(str(tmp_path), 1, {"c": {"__a__": 1}})
+    with pytest.raises(CheckpointError, match="unserializable"):
+        write_checkpoint(str(tmp_path), 1, {"c": {"bad": object()}})
+
+
+def test_store_overwrite_same_step(tmp_path):
+    """A rerun over the same root republishes a step atomically."""
+    root = str(tmp_path)
+    write_checkpoint(root, 5, {"c": {"v": 1}})
+    write_checkpoint(root, 5, {"c": {"v": 2}})
+    _, got, _ = read_checkpoint(root, 5)
+    assert got["c"]["v"] == 2
+
+
+# ---------------------------------------------------------------------------
+# state: rng + pytree capture
+# ---------------------------------------------------------------------------
+
+def test_rng_capture_continues_identical_stream():
+    rng = np.random.default_rng(42)
+    rng.random(10)                      # advance past the seed state
+    snap = capture_rng(rng)
+    want = rng.random(16)               # the stream the resume must match
+    got = restore_rng(snap).random(16)
+    np.testing.assert_array_equal(want, got)
+    # In-place restore (loaders hold their rng privately).
+    other = np.random.default_rng(0)
+    load_rng(other, snap)
+    np.testing.assert_array_equal(want, other.random(16))
+
+
+def test_rng_snapshot_survives_json(tmp_path):
+    """The checkpoint path serializes rng state through the store."""
+    rng = np.random.default_rng(7)
+    rng.permutation(100)
+    write_checkpoint(str(tmp_path), 1, {"rng": capture_rng(rng)})
+    _, comps, _ = read_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(
+        rng.permutation(50), restore_rng(comps["rng"]).permutation(50))
+
+
+def test_pytree_capture_restore_bit_exact():
+    tree = {"w": jnp.asarray(np.random.default_rng(0).normal(size=(3, 4)),
+                             jnp.float32),
+            "b": np.arange(4, dtype=np.int32),
+            "step": 7, "name": "x"}
+    snap = capture_pytree(tree)
+    back = restore_pytree(snap, like=jax.tree_util.tree_map(
+        lambda x: x, tree))
+    assert np.asarray(back["w"] == tree["w"]).all()
+    np.testing.assert_array_equal(back["b"], tree["b"])
+    assert back["step"] == 7 and back["name"] == "x"
+    assert isinstance(back["w"], jax.Array)      # placement follows template
+    assert isinstance(back["b"], np.ndarray)
+
+
+def test_pytree_restore_validates_against_template():
+    tree = {"w": jnp.zeros((2, 2))}
+    snap = capture_pytree(tree)
+    with pytest.raises(CheckpointError, match="leaves"):
+        restore_pytree(snap, like={"w": jnp.zeros((2, 2)),
+                                   "extra": jnp.zeros(1)})
+    with pytest.raises(CheckpointError, match="template"):
+        restore_pytree(snap, like={"w": jnp.zeros((3, 2))})
+    with pytest.raises(CheckpointError, match="template"):
+        restore_pytree(snap, like={"w": jnp.zeros((2, 2), jnp.int32)})
+
+
+# ---------------------------------------------------------------------------
+# component state_dict protocol
+# ---------------------------------------------------------------------------
+
+def test_checkpointer_drives_state_dict_objects(tmp_path):
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def state_dict(self):
+            return {"n": self.n}
+
+        def load_state_dict(self, d):
+            self.n = int(d["n"])
+
+    a = Counter()
+    a.n = 5
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, {"counter": a, "raw": {"v": np.arange(3)}})
+    b = Counter()
+    snap = ck.resume({"counter": b})
+    assert b.n == 5 and snap.step == 1
+    np.testing.assert_array_equal(snap.components["raw"]["v"], np.arange(3))
+
+
+def test_node_loader_state_dict_roundtrip():
+    from glt_tpu.loader import NeighborLoader
+
+    ds, _ = _cluster_dataset()
+    mk = lambda: NeighborLoader(ds, [4, 4], np.arange(48), batch_size=16,
+                                shuffle=True, seed=11)
+    a, b = mk(), mk()
+    for _ in a:                      # epoch 1 advances a's shuffle rng
+        pass
+    b.load_state_dict(a.state_dict())
+    assert b._epoch == a._epoch
+    # Epoch 2's shuffle order now matches draw-for-draw.
+    np.testing.assert_array_equal(a._rng.permutation(48),
+                                  b._rng.permutation(48))
+
+
+def test_remote_client_fence_ratchet():
+    from glt_tpu.distributed.dist_client import RemoteNeighborLoader
+
+    def bare(epoch, num_expected):
+        ld = RemoteNeighborLoader.__new__(RemoteNeighborLoader)
+        ld._epoch = epoch
+        ld._client_key = "k" * 32
+        ld.num_expected = num_expected
+        ld.epoch_stats = {"received": 3, "duplicates": 1, "seqs": {0, 1, 2}}
+        return ld
+
+    sd = bare(4, 3).state_dict()
+    assert sd["epoch"] == 4 and sd["last_epoch_stats"]["seqs"] == [0, 1, 2]
+    fresh = bare(0, 3)
+    fresh.load_state_dict(sd)
+    assert fresh._epoch == 4            # next __iter__ fences epoch 5
+    ahead = bare(9, 3)
+    ahead.load_state_dict(sd)
+    assert ahead._epoch == 9            # fence only ratchets forward
+    with pytest.raises(ValueError, match="checkpoint was taken"):
+        bare(0, 5).load_state_dict(sd)  # different seed set
+
+
+# ---------------------------------------------------------------------------
+# TrainLoop: kill at every step, resume bit-identically
+# ---------------------------------------------------------------------------
+
+def _training_setup(feature_cache=None):
+    ds, labels = _cluster_dataset()
+    model = GraphSAGE(hidden_features=16, out_features=3, num_layers=2,
+                      dropout_rate=0.0)
+    tx = optax.adam(1e-2)
+    sampler = NeighborSampler(ds.get_graph(), [4, 4], batch_size=BATCH,
+                              with_edge=False)
+    feat = ds.get_node_feature()
+    x0 = jnp.zeros((sampler.node_capacity, feat.shape[1]), jnp.float32)
+    ei0 = jnp.full((2, sampler.edge_capacity), -1, jnp.int32)
+    m0 = jnp.zeros((sampler.edge_capacity,), bool)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
+    state = TrainState(params=params, opt_state=tx.init(params),
+                       step=jnp.zeros((), jnp.int32))
+    step = make_scanned_node_train_step(model, tx, sampler, feat, labels,
+                                        BATCH, feature_cache=feature_cache)
+    return step, state
+
+
+# One compiled scanned program serves every cache-less TrainLoop test:
+# the step closure is stateless with feature_cache=None, the initial
+# TrainState is an immutable pytree, and the resume contract explicitly
+# allows a "different process" to reuse any same-config step.  The
+# preempt-at-k sweep "rebuilds from scratch" at the TrainLoop layer
+# (cursor/rng/key/state) — recompiling XLA per test would only re-prove
+# jit determinism at ~5 s a pop against the tier-1 time budget.
+_SHARED = {}
+
+
+def _shared_setup():
+    if not _SHARED:
+        _SHARED["step"], _SHARED["state"] = _training_setup()
+    return _SHARED["step"], _SHARED["state"]
+
+
+def _make_loop(checkpointer=None, fault_plan=None, supervisor=None):
+    step, state = _shared_setup()
+    return TrainLoop(step, state, np.arange(SEEDS), BATCH, GROUP,
+                     epochs=EPOCHS, rng=np.random.default_rng(7),
+                     base_key=jax.random.PRNGKey(3),
+                     checkpointer=checkpointer, fault_plan=fault_plan,
+                     supervisor=supervisor)
+
+
+def _params_equal(a, b) -> bool:
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree_util.tree_leaves(a.params),
+                               jax.tree_util.tree_leaves(b.params)))
+
+
+@pytest.fixture(scope="module")
+def uninterrupted():
+    loop = _make_loop()
+    state = loop.run()
+    assert len(loop.losses) == 6        # 3 real batches x 2 epochs
+    return state, list(loop.losses)
+
+
+@pytest.mark.parametrize("k", list(range(1, TOTAL_STEPS)))
+def test_preempt_at_every_step_resumes_bit_identical(tmp_path, k,
+                                                     uninterrupted):
+    """THE tentpole assertion: preempt after global step k (checkpoint
+    every step), rebuild everything from scratch with WRONG fresh seeds,
+    resume, and the remaining losses + final param bits match the
+    uninterrupted run exactly."""
+    ref_state, ref_losses = uninterrupted
+    root = str(tmp_path)
+    victim = _make_loop(Checkpointer(root, every_n_steps=1, keep=2),
+                        fault_plan=FaultPlan(preempt_at_train_step=k))
+    with pytest.raises(SimulatedPreemption):
+        victim.run()
+    assert latest_step(root) == k
+
+    # "New process": fresh loop state; deliberately different rng/key —
+    # resume() must overwrite both from the checkpoint.
+    step, state = _shared_setup()
+    revived = TrainLoop(step, state, np.arange(SEEDS), BATCH, GROUP,
+                        epochs=EPOCHS, rng=np.random.default_rng(999),
+                        base_key=jax.random.PRNGKey(0),
+                        checkpointer=Checkpointer(root))
+    snap = revived.resume()
+    assert snap is not None and snap.step == k
+    final = revived.run()
+    assert revived.losses == ref_losses[len(ref_losses)
+                                        - len(revived.losses):]
+    assert _params_equal(final, ref_state)
+
+
+def test_resume_falls_back_past_corrupt_newest(tmp_path, uninterrupted):
+    ref_state, ref_losses = uninterrupted
+    root = str(tmp_path)
+    victim = _make_loop(Checkpointer(root, every_n_steps=1, keep=3),
+                        fault_plan=FaultPlan(preempt_at_train_step=2))
+    with pytest.raises(SimulatedPreemption):
+        victim.run()
+    # Torn disk: newest checkpoint's arrays fail their checksum.
+    with open(os.path.join(root, "step_00000002", "arrays.npz"),
+              "r+b") as fh:
+        fh.seek(40)
+        fh.write(b"\x00\x00\x00")
+    revived = _make_loop(Checkpointer(root))
+    snap = revived.resume()
+    assert snap.step == 1               # one step of progress re-done
+    final = revived.run()
+    assert revived.losses == ref_losses[len(ref_losses)
+                                        - len(revived.losses):]
+    assert _params_equal(final, ref_state)
+
+
+@pytest.mark.slow
+def test_feature_cache_state_rides_checkpoints(tmp_path):
+    """The cross-batch HBM cache is captured/restored: the resumed run's
+    cache stats match the uninterrupted run's (the cache never changes
+    x, so this is about warm state + deterministic accounting).  Slow:
+    the donated-cache program compiles per loop (three compiles)."""
+    from glt_tpu.data.feature_cache import cache_init
+
+    def cached_loop(checkpointer=None, fault_plan=None):
+        step, state = _training_setup(feature_cache=cache_init(48, 32, 8))
+        return TrainLoop(step, state, np.arange(SEEDS), BATCH, GROUP,
+                         epochs=EPOCHS, rng=np.random.default_rng(7),
+                         base_key=jax.random.PRNGKey(3),
+                         checkpointer=checkpointer, fault_plan=fault_plan)
+
+    ref = cached_loop()
+    ref_state = ref.run()
+    ref_cache = ref.step.feature_cache()
+
+    root = str(tmp_path)
+    victim = cached_loop(Checkpointer(root, every_n_steps=1, keep=2),
+                         fault_plan=FaultPlan(preempt_at_train_step=2))
+    with pytest.raises(SimulatedPreemption):
+        victim.run()
+    revived = cached_loop(Checkpointer(root))
+    assert revived.resume() is not None
+    final = revived.run()
+    assert _params_equal(final, ref_state)
+    got_cache = revived.step.feature_cache()
+    assert int(got_cache.hits) == int(ref_cache.hits)
+    assert int(got_cache.misses) == int(ref_cache.misses)
+    np.testing.assert_array_equal(np.asarray(got_cache.slot_ids),
+                                  np.asarray(ref_cache.slot_ids))
+
+
+def test_trainloop_without_checkpointer_is_plain(uninterrupted):
+    _, ref_losses = uninterrupted
+    loop = _make_loop()
+    assert loop.resume() is None
+    loop.run()
+    assert loop.losses == ref_losses
+
+
+@pytest.mark.slow
+def test_real_sigkill_resume_bit_identical(tmp_path):
+    """The honest version: a subprocess SIGKILLs ITSELF mid-epoch (no
+    atexit, no cleanup), a second subprocess resumes from the published
+    checkpoints, and losses + param digest match an uninterrupted
+    subprocess run of the identical schedule."""
+    worker = os.path.join(REPO, "tests", "_ckpt_worker.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def run(*args):
+        return subprocess.run([sys.executable, worker, *args],
+                              capture_output=True, text=True, env=env,
+                              cwd=REPO, timeout=240)
+
+    ref_root = str(tmp_path / "ref")
+    ref_json = str(tmp_path / "ref.json")
+    proc = run(ref_root, ref_json)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    ref = json.load(open(ref_json))
+
+    root = str(tmp_path / "chaos")
+    out = str(tmp_path / "chaos.json")
+    killed = run(root, out, "3")
+    assert killed.returncode == -signal.SIGKILL, (killed.returncode,
+                                                  killed.stderr[-2000:])
+    assert not os.path.exists(out)      # died before finishing
+    assert latest_step(root) == 3       # ... but its checkpoints published
+    resumed = run(root, out)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    got = json.load(open(out))
+    assert got["resumed_from"] == 3
+    assert got["param_digest"] == ref["param_digest"]
+    assert got["losses"] == ref["losses"][len(ref["losses"])
+                                          - len(got["losses"]):]
+
+
+# ---------------------------------------------------------------------------
+# supervisor: heartbeats, deadlines, structured exit
+# ---------------------------------------------------------------------------
+
+def _wait_until(cond, timeout=5.0, poll=0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(poll)
+    return False
+
+
+def test_supervisor_detects_dead_peer_within_deadline():
+    from glt_tpu.distributed.supervisor import PeerDeadError, Supervisor
+
+    sup = Supervisor(deadline_secs=0.3, poll_interval=0.05)
+    sup.beat("trainer-0")
+    t0 = time.monotonic()
+    assert _wait_until(lambda: "trainer-0" in sup.dead_peers(), timeout=5)
+    detect = time.monotonic() - t0
+    # Bounded detection: deadline + at most ~2 polls of slack.
+    assert 0.25 <= detect < 2.0, detect
+    with pytest.raises(PeerDeadError) as err:
+        sup.raise_if_dead()
+    assert err.value.report["reason"] == "peer_dead"
+    assert err.value.report["peer"] == "trainer-0"
+    # A resurrected peer (restarted process) clears its death mark.
+    sup.beat("trainer-0")
+    sup.raise_if_dead()
+    sup.stop()
+
+
+def test_supervisor_on_dead_callback_fires_once():
+    from glt_tpu.distributed.supervisor import Supervisor
+
+    deaths = []
+    sup = Supervisor(deadline_secs=0.2, poll_interval=0.05,
+                     on_dead=lambda name, rep: deaths.append((name, rep)))
+    sup.register("loader-1")
+    assert _wait_until(lambda: deaths, timeout=5)
+    time.sleep(0.3)                     # more polls pass; still one death
+    assert len(deaths) == 1
+    assert deaths[0][0] == "loader-1"
+    sup.stop()
+
+
+def test_supervisor_watch_probe_keeps_peer_alive():
+    from glt_tpu.distributed.supervisor import Supervisor
+
+    sup = Supervisor(deadline_secs=0.4, poll_interval=0.05)
+    healthy = threading.Event()
+    healthy.set()
+
+    def probe():
+        if not healthy.is_set():
+            raise ConnectionError("down")
+
+    sup.watch("server-0", probe, interval=0.05)
+    time.sleep(0.8)
+    assert sup.dead_peers() == []       # probed alive past 2 deadlines
+    healthy.clear()                     # silence IS the signal
+    assert _wait_until(lambda: "server-0" in sup.dead_peers(), timeout=5)
+    sup.stop()
+
+
+def test_run_with_deadline_bounds_a_hang():
+    from glt_tpu.distributed.supervisor import (BarrierTimeoutError,
+                                                run_with_deadline)
+
+    assert run_with_deadline(lambda: 42, 1.0) == 42
+    with pytest.raises(ValueError, match="boom"):
+        run_with_deadline(lambda: (_ for _ in ()).throw(
+            ValueError("boom")), 1.0)
+    t0 = time.monotonic()
+    with pytest.raises(BarrierTimeoutError) as err:
+        run_with_deadline(lambda: time.sleep(30), 0.3,
+                          what="barrier 'epoch_end'")
+    assert time.monotonic() - t0 < 5.0  # bounded, not 30s
+    assert err.value.report["reason"] == "barrier_timeout"
+
+
+def test_timed_barrier_and_multihost_helpers_single_process():
+    from glt_tpu.distributed.supervisor import timed_barrier
+    from glt_tpu.parallel import multihost
+
+    timed_barrier("test", timeout_s=0.5)          # no-op, returns
+    multihost.barrier("test", timeout_s=0.5)      # ditto
+    assert multihost.agree_max(3) == 3
+    np.testing.assert_array_equal(multihost.agree_sum(np.arange(4)),
+                                  np.arange(4))
+
+
+def test_collective_deadline_env_parsing(monkeypatch):
+    from glt_tpu.parallel import multihost
+
+    monkeypatch.delenv(multihost.TIMEOUT_ENV, raising=False)
+    assert multihost.collective_deadline_secs() == 0.0
+    monkeypatch.setenv(multihost.TIMEOUT_ENV, "12.5")
+    assert multihost.collective_deadline_secs() == 12.5
+    monkeypatch.setenv(multihost.TIMEOUT_ENV, "nonsense")
+    assert multihost.collective_deadline_secs() == 0.0
+
+
+def test_trainloop_supervised_exit_checkpoints_and_raises(tmp_path):
+    """A dead peer mid-run: the loop must NOT hang — it publishes an
+    emergency checkpoint carrying the structured reason and raises
+    SupervisedExit, all within a bounded wall time."""
+    from glt_tpu.distributed.supervisor import SupervisedExit, Supervisor
+
+    sup = Supervisor(deadline_secs=0.15, poll_interval=0.05)
+    sup.register("producer-7")          # never beats: dead after 0.15 s
+    root = str(tmp_path)
+    loop = _make_loop(Checkpointer(root, every_n_steps=1, keep=2),
+                      supervisor=sup)
+    time.sleep(0.5)                     # let the deadline expire
+    t0 = time.monotonic()
+    with pytest.raises(SupervisedExit) as err:
+        loop.run()
+    assert time.monotonic() - t0 < 60.0
+    sup.stop()
+    assert err.value.report["reason"] == "peer_dead"
+    assert err.value.report["peer"] == "producer-7"
+    assert err.value.checkpoint_path is not None
+    # The emergency checkpoint is readable and records why it exists.
+    step, comps, extras = read_checkpoint(root)
+    assert extras["exit_reason"]["reason"] == "peer_dead"
+    assert "train_state" in comps and "loop" in comps
+    # ... and a fresh loop resumes from it.
+    revived = _make_loop(Checkpointer(root))
+    snap = revived.resume()
+    assert snap.step == err.value.step
+    revived.run()
+
+
+@pytest.mark.slow
+def test_pipelined_epoch_start_batch_replays_identical_suffix(tmp_path):
+    """The overlapped (sample k+1 || train k) driver carries the same
+    resume seam: checkpoint after batch k, restart from a fresh state
+    template at start_batch=k+1, suffix losses bit-equal.  Slow: the
+    fused pipelined program is its own (expensive) compile."""
+    from glt_tpu.models import make_pipelined_train_step
+    from glt_tpu.models.train import run_pipelined_epoch
+
+    ds, labels = _cluster_dataset()
+    model = GraphSAGE(hidden_features=16, out_features=3, num_layers=2,
+                      dropout_rate=0.0)
+    tx = optax.adam(1e-2)
+    bs = 16
+    sampler = NeighborSampler(ds.get_graph(), [4, 4], batch_size=bs,
+                              with_edge=False)
+    feat = ds.get_node_feature()
+    x0 = jnp.zeros((sampler.node_capacity, feat.shape[1]), jnp.float32)
+    ei0 = jnp.full((2, sampler.edge_capacity), -1, jnp.int32)
+    m0 = jnp.zeros((sampler.edge_capacity,), bool)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
+
+    def fresh():
+        return TrainState(params=params, opt_state=tx.init(params),
+                          step=jnp.zeros((), jnp.int32))
+
+    batches = [np.arange(i * bs, (i + 1) * bs).astype(np.int32)
+               for i in range(4)]
+    base = jax.random.PRNGKey(42)
+    step, sample_first = make_pipelined_train_step(
+        model, tx, sampler, feat, labels, bs)
+
+    ck = Checkpointer(str(tmp_path))
+
+    def save_at(state, i):
+        if i == 1:
+            ck.save(i + 1, {"train_state": capture_pytree(state)})
+
+    full_state, full_losses, _ = run_pipelined_epoch(
+        step, sample_first, batches, fresh(), base, on_step=save_at)
+    full_losses = [float(x) for x in full_losses]
+
+    snap = Checkpointer(str(tmp_path)).resume()
+    revived = restore_pytree(snap.components["train_state"], like=fresh())
+    part_state, part_losses, _ = run_pipelined_epoch(
+        step, sample_first, batches, revived, base,
+        start_batch=snap.step)
+    assert [float(x) for x in part_losses] == full_losses[snap.step:]
+    assert _params_equal(part_state, full_state)
+
+
+# ---------------------------------------------------------------------------
+# dist_train epoch driver: resume seam parity
+# ---------------------------------------------------------------------------
+
+class _StubPipeline:
+    """Minimal stand-in exposing exactly what run_epoch touches."""
+
+    def __init__(self):
+        from glt_tpu.parallel.dist_train import _ColdStagePipeline
+
+        self._run_epoch = _ColdStagePipeline.run_epoch.__get__(self)
+        self.mesh = None
+        self.axis_name = "shard"
+
+        class _Sampler:
+            @staticmethod
+            def sample_from_nodes(seeds, key=None):
+                return seeds
+
+        self.sampler = _Sampler()
+
+        @jax.jit
+        def train_step(state, out, staged, key):
+            state = state + jnp.sum(out) * 1e-3 \
+                + jax.random.uniform(key) * 1e-6
+            return state, state, state
+
+        self.train_step = train_step
+
+    def _stage_cold_async(self, out):
+        class _Done:
+            @staticmethod
+            def result():
+                return out
+
+        return _Done()
+
+
+def test_dist_run_epoch_start_batch_replays_identical_suffix():
+    pipe = _StubPipeline()
+    batches = [jnp.full((2, 4), i, jnp.float32) for i in range(6)]
+    key = jax.random.PRNGKey(5)
+    state0 = jnp.zeros(())
+
+    full_idx = []
+    full_state, full_losses, _ = pipe._run_epoch(
+        state0, batches, key, on_batch=lambda s, i: full_idx.append(i))
+    assert full_idx == list(range(6))
+
+    # Resume from batch 3 with the checkpointed state: the suffix must
+    # match the full run batch-for-batch (absolute-position keys).
+    ckpt_state = None
+
+    def grab(s, i):
+        nonlocal ckpt_state
+        if i == 2:
+            ckpt_state = s
+
+    pipe._run_epoch(state0, batches, key, on_batch=grab)
+    part_state, part_losses, _ = pipe._run_epoch(
+        ckpt_state, batches, key, start_batch=3)
+    assert float(part_state) == float(full_state)
+    np.testing.assert_array_equal(
+        np.asarray([float(x) for x in part_losses]),
+        np.asarray([float(x) for x in full_losses[3:]]))
+
+
+def test_dist_run_epoch_supervisor_raises_structured():
+    from glt_tpu.distributed.supervisor import PeerDeadError, Supervisor
+
+    pipe = _StubPipeline()
+    sup = Supervisor(deadline_secs=0.1, poll_interval=0.03)
+    sup.register("host-1")
+    time.sleep(0.4)
+    with pytest.raises(PeerDeadError):
+        pipe._run_epoch(jnp.zeros(()),
+                        [jnp.ones((2, 4)) for _ in range(4)],
+                        jax.random.PRNGKey(0), supervisor=sup)
+    sup.stop()
+
+
+# ---------------------------------------------------------------------------
+# server heartbeats + composition with PR-4 replay
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def hb_server():
+    from glt_tpu.distributed.dist_server import init_server
+    from tests.test_dist_loader import build_ring_dataset
+
+    srv = init_server(build_ring_dataset(), heartbeat_deadline=0.5)
+    yield srv
+    srv.supervisor.stop()
+    srv.shutdown()
+
+
+def test_heartbeat_op_and_fleet_health(hb_server):
+    from glt_tpu.distributed.dist_client import RemoteServerConnection
+
+    conn = RemoteServerConnection(hb_server.addr)
+    assert conn.request(op="heartbeat", peer="trainer-3", step=17)["ok"]
+    health = conn.request(op="fleet_health")
+    assert health["peers"]["trainer-3"]["alive"]
+    assert health["peers"]["trainer-3"]["step"] == 17
+    # Silence past the server's deadline -> declared dead in the table.
+    assert _wait_until(
+        lambda: not conn.request(
+            op="fleet_health")["peers"]["trainer-3"]["alive"],
+        timeout=5)
+    conn.close()
+
+
+def test_heartbeat_sender_keeps_peer_alive(hb_server):
+    from glt_tpu.distributed.dist_client import RemoteServerConnection
+    from glt_tpu.distributed.supervisor import HeartbeatSender
+
+    conn = RemoteServerConnection(hb_server.addr)
+    steps = iter(range(1000))
+    sender = HeartbeatSender(conn, "trainer-9", interval_secs=0.1,
+                             step_fn=lambda: next(steps))
+    probe = RemoteServerConnection(hb_server.addr)
+    time.sleep(1.2)                     # > 2 server deadlines
+    health = probe.request(op="fleet_health")["peers"]["trainer-9"]
+    assert health["alive"] and health["step"] is not None
+    assert sender.sent >= 5 and sender.failures == 0
+    sender.stop()
+    assert _wait_until(
+        lambda: not probe.request(
+            op="fleet_health")["peers"]["trainer-9"]["alive"],
+        timeout=5)
+    probe.close()
+    conn.close()
+
+
+def test_client_resume_composes_with_remote_replay(tmp_path):
+    """Satellite: resume WHILE the remote sampling channel is also
+    reconnecting.  Epoch 1 runs under connection-drop weather (PR-4
+    replay covers it); the client checkpoints, "dies", and a fresh
+    client restores the epoch fence and runs its next epoch under drop
+    weather again — exactly-once delivery both times."""
+    from glt_tpu.distributed.dist_client import RemoteNeighborLoader
+    from glt_tpu.distributed.dist_server import init_server
+    from tests.test_dist_loader import build_ring_dataset
+
+    srv = init_server(build_ring_dataset())
+    try:
+        plan_a = FaultPlan(drop_after_frames=6, max_faulty_conns=1)
+        a = RemoteNeighborLoader(srv.addr, [2, 2], np.arange(24),
+                                 batch_size=5, seed=0, fault_plan=plan_a)
+        n1 = sum(1 for _ in a)          # epoch 1 under drop weather
+        assert n1 == a.num_expected
+        assert a.epoch_stats["reconnects"] >= 1     # weather happened
+        assert len(a.epoch_stats["seqs"]) == a.num_expected
+
+        ck = Checkpointer(str(tmp_path))
+        ck.save(1, {"remote_loader": a})
+        # Crash the client process: abandon the loader unclosed (its
+        # producer lease on the server will simply expire).
+        a.conn.interrupt()
+        a.conn.close()
+
+        plan_b = FaultPlan(drop_after_frames=6, max_faulty_conns=1)
+        b = RemoteNeighborLoader(srv.addr, [2, 2], np.arange(24),
+                                 batch_size=5, seed=0, fault_plan=plan_b)
+        snap = ck.resume({"remote_loader": b})
+        assert snap.components["remote_loader"]["epoch"] == 1
+        assert b._epoch == 1            # fence restored
+        n2 = sum(1 for _ in b)          # resume epoch, ALSO under drops
+        assert n2 == b.num_expected
+        assert b.epoch_stats["duplicates"] >= 0
+        assert len(b.epoch_stats["seqs"]) == b.num_expected
+        assert b._epoch == 2            # ran as the post-fence epoch
+        b.shutdown()
+    finally:
+        srv.shutdown()

@@ -6,6 +6,8 @@ nest, device timings non-negative), and the disabled instrumentation
 path is a near-free no-op (overhead smoke).
 """
 import json
+import os
+import signal
 import subprocess
 import sys
 import time
@@ -20,6 +22,8 @@ from glt_tpu import obs
 from glt_tpu.obs import metrics
 from glt_tpu.obs.summarize import format_summary, summarize_trace
 from glt_tpu.obs.trace import Tracer, validate_chrome_trace
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture(autouse=True)
@@ -485,3 +489,83 @@ def test_loader_counts_batches_when_enabled():
     snap = metrics.snapshot()
     assert snap["glt.loader.batches"] - before == len(batches) == 4
     assert snap["glt.loader.sample_dispatch_ms.count"] >= 4
+
+
+# ---------------------------------------------------------------------------
+# crash-time trace flush (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+class TestCrashTimeFlush:
+    def test_flush_exports_writes_registered_paths(self, tmp_path,
+                                                   monkeypatch):
+        from glt_tpu.obs import trace as trace_mod
+
+        monkeypatch.setenv(trace_mod.TRACE_DIR_ENV, str(tmp_path))
+        monkeypatch.setattr(trace_mod, "_flush_paths", set())
+        path = trace_mod.auto_trace("worker3")
+        assert path is not None
+        with obs.span("work"):
+            time.sleep(0.001)
+        written = trace_mod.flush_exports(reason="unit-test")
+        assert written == [path] and os.path.isfile(path)
+        doc = json.load(open(path))
+        assert validate_chrome_trace(doc) == []
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert "work" in names and "trace.flush" in names
+        # Idempotent: a later flush (atexit after a supervisor flush)
+        # republishes a complete snapshot.
+        assert trace_mod.flush_exports() == [path]
+        assert validate_chrome_trace(json.load(open(path))) == []
+
+    def test_flush_exports_noop_without_registration(self, monkeypatch):
+        from glt_tpu.obs import trace as trace_mod
+
+        monkeypatch.setattr(trace_mod, "_flush_paths", set())
+        obs.start_trace()
+        assert trace_mod.flush_exports() == []
+
+    def test_export_is_atomic(self, tmp_path):
+        """export never leaves a torn file at the final path — the
+        property the SIGTERM-time flush depends on (GLT011)."""
+        t = Tracer()
+        with t.span("s"):
+            pass
+        out = tmp_path / "trace.json"
+        t.export(str(out))
+        assert validate_chrome_trace(json.load(open(out))) == []
+        leftovers = [p for p in os.listdir(tmp_path)
+                     if p.startswith("trace.json.tmp")]
+        assert leftovers == []
+
+    def test_sigterm_flushes_partial_trace_subprocess(self, tmp_path):
+        """A SIGTERMed fleet process exports its partial trace before
+        dying WITH signal-death exit status (the parent supervisor must
+        still see the kill).  SIGKILL is unflushable by design — the
+        supervisor's peer-side spans cover that case."""
+        script = (
+            "import os, sys, time\n"
+            "sys.path.insert(0, %r)\n"
+            "from glt_tpu.obs import trace\n"
+            "path = trace.auto_trace('victim')\n"
+            "tr = trace.current()\n"
+            "with tr.span('doomed_epoch'):\n"
+            "    print('READY', flush=True)\n"
+            "    time.sleep(30)\n" % REPO_ROOT
+        )
+        env = {**os.environ, "GLT_OBS_TRACE_DIR": str(tmp_path)}
+        proc = subprocess.Popen([sys.executable, "-c", script], env=env,
+                                stdout=subprocess.PIPE, text=True)
+        try:
+            assert proc.stdout.readline().strip() == "READY"
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert rc == -signal.SIGTERM
+        files = [p for p in os.listdir(tmp_path)
+                 if p.startswith("trace-victim-")]
+        assert len(files) == 1
+        doc = json.load(open(os.path.join(str(tmp_path), files[0])))
+        args = {e["name"]: e.get("args", {}) for e in doc["traceEvents"]}
+        assert args.get("trace.flush", {}).get("reason") == "sigterm"
