@@ -1,0 +1,323 @@
+"""Serving latency/throughput bench: p50/p99 vs offered load + the
+coalescing win.
+
+What it measures (ISSUE 9 acceptance, tracked by obs.regress):
+
+  * ``serving_single_ms``      — one uncontended request, closed loop
+                                 (median): the latency floor.
+  * ``serving_rps_per_request``— saturating closed-loop throughput with
+                                 coalescing DISABLED
+                                 (max_batch_requests=1): every request
+                                 pays its own device dispatch.
+  * ``serving_rps_coalesced``  — same offered pressure with coalescing
+                                 ON: outstanding requests share one
+                                 micro-batch program.
+  * ``serving_coalesce_speedup`` = coalesced / per-request (>1.5 at
+                                 saturation is the acceptance bar).
+  * ``serving_p50_ms`` / ``serving_p99_ms`` — open-loop Poisson traffic
+                                 at ~50% of measured saturation.
+  * ``serving_p99_light_ms``   — open-loop at ~10% saturation: must
+                                 stay within ~2x of serving_single_ms.
+  * ``serving_overload_reject_frac`` — open loop at 2x saturation:
+                                 fraction rejected with structured
+                                 Overloaded; accepted requests still
+                                 complete (bounded queues, no
+                                 unbounded growth).
+
+Methodology notes (docs/serving.md "Bench methodology"): open loop
+means arrival times are drawn from a Poisson process up front and each
+worker sleeps until its request's scheduled arrival — a slow server
+does NOT slow the arrival rate, which is what exposes queueing/overload
+behavior closed-loop benches hide.  Each phase asserts result validity
+(seed echo) before its timing is trusted.
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/bench_serving.py
+Prints one JSON line (also written atomically to $GLT_BENCH_OUT).
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_ring_dataset(n, dim=16):
+    from glt_tpu.data import Dataset
+
+    src = np.repeat(np.arange(n), 2)
+    dst = np.concatenate([[(i + 1) % n, (i + 2) % n] for i in range(n)])
+    feat = np.arange(n, dtype=np.float32)[:, None] * np.ones((1, dim),
+                                                             np.float32)
+    labels = np.arange(n, dtype=np.int32) % 7
+    return (Dataset()
+            .init_graph(np.stack([src, dst]), graph_mode="HOST",
+                        num_nodes=n)
+            .init_node_features(feat)
+            .init_node_labels(labels))
+
+
+def make_server(ds, coalesce, args, max_batch_requests=None,
+                max_inflight=None):
+    from glt_tpu.distributed import init_server
+    from glt_tpu.serving import ServingOptions
+
+    opts = ServingOptions(
+        num_neighbors=list(args.fanouts),
+        seed_buckets=tuple(args.buckets),
+        max_seeds_per_request=args.max_seeds,
+        max_batch_requests=(max_batch_requests if max_batch_requests
+                            else (args.max_batch_requests
+                                  if coalesce else 1)),
+        max_wait_ms=args.max_wait_ms if coalesce else 0.0,
+        max_inflight=max_inflight or args.max_inflight,
+        default_deadline_ms=60_000.0)
+    srv = init_server(ds, serving=opts)
+    srv.serving.engine.warmup()     # compiles out of the timed phases
+    return srv
+
+
+class _Recorder:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.lat_ms = []
+        self.ok = 0
+        self.overloaded = 0
+        self.deadline = 0
+        self.errors = 0
+
+    def add(self, kind, ms=None):
+        with self.lock:
+            if kind == "ok":
+                self.ok += 1
+                self.lat_ms.append(ms)
+            elif kind == "overloaded":
+                self.overloaded += 1
+            elif kind == "deadline":
+                self.deadline += 1
+            else:
+                self.errors += 1
+
+    @property
+    def total(self):
+        return self.ok + self.overloaded + self.deadline + self.errors
+
+
+def _one_request(cli, rng, n, max_seeds, rec, deadline_s):
+    from glt_tpu.serving import DeadlineExceeded, Overloaded, ServingError
+
+    k = int(rng.integers(1, max_seeds + 1))
+    seeds = rng.integers(0, n, size=k)
+    t0 = time.perf_counter()
+    try:
+        b = cli.subgraph(seeds, timeout=deadline_s)
+        ms = (time.perf_counter() - t0) * 1e3
+        got = np.asarray(b.batch).tolist()
+        want = list(dict.fromkeys(int(s) for s in seeds))
+        assert got == want, (got, want)   # validity before timing
+        rec.add("ok", ms)
+    except Overloaded:
+        rec.add("overloaded")
+    except DeadlineExceeded:
+        rec.add("deadline")
+    except ServingError:
+        rec.add("error")
+
+
+def closed_loop(addr, n, args, num_threads, duration_s):
+    """Saturating pressure: every thread fires back-to-back requests."""
+    from glt_tpu.serving import InferenceClient
+
+    rec = _Recorder()
+    stop = threading.Event()
+
+    def worker(idx):
+        cli = InferenceClient(addr, timeout=60.0)
+        rng = np.random.default_rng(1000 + idx)
+        while not stop.is_set():
+            _one_request(cli, rng, n, args.max_seeds, rec, 60.0)
+        cli.close()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(num_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    elapsed = time.perf_counter() - t0
+    return rec, rec.ok / elapsed
+
+
+def open_loop(addr, n, args, offered_rps, duration_s, deadline_s=60.0,
+              num_threads=16, seed=7):
+    """Poisson arrivals at ``offered_rps``, independent of completion
+    rate: workers pull the next scheduled arrival, sleep until it, and
+    fire — late workers fire immediately (arrival backlog), which is
+    exactly how an overloaded open system behaves."""
+    from glt_tpu.serving import InferenceClient
+
+    rng = np.random.default_rng(seed)
+    count = max(1, int(offered_rps * duration_s))
+    gaps = rng.exponential(1.0 / offered_rps, size=count)
+    arrivals = np.cumsum(gaps)
+    rec = _Recorder()
+    it_lock = threading.Lock()
+    next_i = [0]
+    t_start = time.perf_counter()
+
+    def worker(idx):
+        cli = InferenceClient(addr, timeout=60.0)
+        wrng = np.random.default_rng(2000 + idx)
+        while True:
+            with it_lock:
+                i = next_i[0]
+                if i >= count:
+                    break
+                next_i[0] += 1
+            delay = arrivals[i] - (time.perf_counter() - t_start)
+            if delay > 0:
+                time.sleep(delay)
+            _one_request(cli, wrng, n, args.max_seeds, rec, deadline_s)
+        cli.close()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(num_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s * 10 + 60)
+    return rec
+
+
+def quantiles(lat_ms):
+    if not lat_ms:
+        return None, None
+    arr = np.asarray(lat_ms)
+    return (round(float(np.percentile(arr, 50)), 3),
+            round(float(np.percentile(arr, 99)), 3))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    small = os.environ.get("GLT_BENCH_SCALE") == "small"
+    ap.add_argument("--nodes", type=int, default=512 if small else 4096)
+    ap.add_argument("--fanouts", type=int, nargs="+",
+                    default=[3, 2] if small else [5, 5])
+    ap.add_argument("--buckets", type=int, nargs="+",
+                    default=[8, 32, 128])
+    ap.add_argument("--max-seeds", type=int, default=8)
+    ap.add_argument("--max-batch-requests", type=int, default=32)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--max-inflight", type=int, default=64)
+    ap.add_argument("--threads", type=int, default=8 if small else 16)
+    ap.add_argument("--duration", type=float,
+                    default=1.0 if small else 3.0)
+    args = ap.parse_args()
+
+    from glt_tpu.serving import InferenceClient
+
+    ds = build_ring_dataset(args.nodes)
+    out = {"nodes": args.nodes, "fanouts": list(args.fanouts),
+           "threads": args.threads, "max_seeds": args.max_seeds}
+
+    # -- phase 1: per-request dispatch baseline (coalescing OFF) ----------
+    srv = make_server(ds, coalesce=False, args=args)
+    try:
+        rec, _ = closed_loop(srv.addr, args.nodes, args,
+                             num_threads=2, duration_s=args.duration / 2)
+        _, rps_solo = closed_loop(srv.addr, args.nodes, args,
+                                  num_threads=args.threads,
+                                  duration_s=args.duration)
+        out["serving_rps_per_request"] = round(rps_solo, 2)
+    finally:
+        srv.shutdown()
+
+    # -- phase 2: coalesced server — the rest of the phases ---------------
+    srv = make_server(ds, coalesce=True, args=args)
+    try:
+        # single uncontended latency floor
+        cli = InferenceClient(srv.addr, timeout=60.0)
+        rng = np.random.default_rng(0)
+        rec = _Recorder()
+        for _ in range(100 if small else 300):
+            _one_request(cli, rng, args.nodes, args.max_seeds, rec, 60.0)
+        cli.close()
+        single_ms = round(float(np.median(rec.lat_ms)), 3)
+        out["serving_single_ms"] = single_ms
+
+        # saturating coalesced throughput
+        _, _ = closed_loop(srv.addr, args.nodes, args, num_threads=2,
+                           duration_s=args.duration / 2)       # warm
+        rec, rps_coal = closed_loop(srv.addr, args.nodes, args,
+                                    num_threads=args.threads,
+                                    duration_s=args.duration)
+        out["serving_rps_coalesced"] = round(rps_coal, 2)
+        out["serving_coalesce_speedup"] = round(
+            rps_coal / max(rps_solo, 1e-9), 3)
+
+        # open-loop Poisson: light (10%) and loaded (50%) of saturation
+        light = open_loop(srv.addr, args.nodes, args,
+                          offered_rps=max(1.0, 0.1 * rps_coal),
+                          duration_s=args.duration)
+        p50, p99 = quantiles(light.lat_ms)
+        out["serving_p50_light_ms"] = p50
+        out["serving_p99_light_ms"] = p99
+        loaded = open_loop(srv.addr, args.nodes, args,
+                           offered_rps=max(1.0, 0.5 * rps_coal),
+                           duration_s=args.duration)
+        p50, p99 = quantiles(loaded.lat_ms)
+        out["serving_p50_ms"] = p50
+        out["serving_p99_ms"] = p99
+        out["serving_offered_rps"] = round(0.5 * rps_coal, 2)
+    finally:
+        srv.shutdown()
+
+    # -- phase 3: 2x overload against a capacity-constrained server -------
+    # The coalescer makes loopback saturation unreachable for a bench
+    # host, so overload behavior is demonstrated on a deliberately
+    # capacity-bounded config (narrow batching, small admission queue):
+    # measure ITS saturation, then offer 2x that open-loop.  The
+    # contract under test is the same: bounded queues, structured
+    # Overloaded for the excess, accepted requests still served.
+    srv = make_server(ds, coalesce=True, args=args,
+                      max_batch_requests=2, max_inflight=8)
+    try:
+        _, rps_cap = closed_loop(srv.addr, args.nodes, args,
+                                 num_threads=4,
+                                 duration_s=args.duration / 2)
+        over = open_loop(srv.addr, args.nodes, args,
+                         offered_rps=max(2.0, 2.0 * rps_cap),
+                         duration_s=args.duration, deadline_s=2.0,
+                         num_threads=32)
+        stats = srv.serving.stats()
+        out["serving_overload_offered_rps"] = round(2.0 * rps_cap, 2)
+        out["serving_overload_reject_frac"] = round(
+            (over.overloaded + over.deadline) / max(over.total, 1), 4)
+        p50, p99 = quantiles(over.lat_ms)
+        out["serving_p99_overload_accepted_ms"] = p99
+        out["serving_inflight_bound"] = stats["max_inflight"]
+        assert stats["inflight"] <= stats["max_inflight"]
+        assert over.errors == 0, "overload must reject structurally"
+    finally:
+        srv.shutdown()
+
+    line = json.dumps(out)
+    print(line, flush=True)
+    bench_out = os.environ.get("GLT_BENCH_OUT")
+    if bench_out:
+        tmp = f"{bench_out}.tmp-{os.getpid()}"
+        with open(tmp, "w") as fh:
+            fh.write(line + "\n")
+        os.replace(tmp, bench_out)
+
+
+if __name__ == "__main__":
+    main()

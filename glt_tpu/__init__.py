@@ -20,6 +20,8 @@ Subpackages:
   channel    SampleMessage serialization + native shm ring queue
   ckpt       durable data-path checkpoints + bit-identical resume
   obs        tracing (Chrome-trace spans), metrics registry, roofline
+  serving    low-latency inference serving: cross-request micro-batching,
+             admission control, InferenceClient
   utils      topo/tensor helpers, profiler, checkpointing
   testing    deterministic fault injection for chaos tests
 """
@@ -33,7 +35,7 @@ from .typing import EdgeType, NodeType, PADDING_ID  # noqa: F401
 # and usable for pure-host tooling (partitioning scripts etc.).
 _SUBMODULES = ("data", "ops", "sampler", "loader", "models", "parallel",
                "partition", "distributed", "channel", "ckpt", "obs",
-               "utils", "testing")
+               "serving", "utils", "testing")
 
 
 def __getattr__(name):

@@ -1132,6 +1132,76 @@ class NonAtomicStatePublish(Rule):
                                   for ch in self._WRITE_MODES) else None)
 
 
+# ---------------------------------------------------------------------------
+# GLT012 unbounded-queue-put
+# ---------------------------------------------------------------------------
+
+@register
+class UnboundedQueuePut(Rule):
+    """``queue.Queue()`` built without a ``maxsize`` bound.
+
+    The backpressure hole the serving/server paths must not have: an
+    unbounded queue between a fast producer (accepting connections,
+    admitting requests) and a slower consumer grows until the process
+    OOMs — under overload the correct behavior is a bounded queue whose
+    ``put_nowait``/``Full`` turns into a structured ``Overloaded``
+    rejection (glt_tpu.serving.front) or a stop-aware ``bounded_put``
+    (channel.base).  Flags ``queue.Queue()`` / ``LifoQueue`` /
+    ``PriorityQueue`` constructed with no ``maxsize`` (or an explicit
+    ``maxsize<=0``, which stdlib treats as infinite), and
+    ``queue.SimpleQueue()`` (unboundable by design).  Multiprocessing
+    queues are out of scope: they are sized by their pipe buffers and
+    used as small task queues here.
+    """
+    name = "unbounded-queue-put"
+    code = "GLT012"
+    severity = Severity.ERROR
+    description = ("queue.Queue() constructed without a positive maxsize "
+                   "bound (unbounded growth under backpressure)")
+
+    _BOUNDED_CLASSES = {"queue.Queue", "queue.LifoQueue",
+                        "queue.PriorityQueue"}
+    _UNBOUNDABLE = {"queue.SimpleQueue"}
+
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = module.call_name(node)
+            if name in self._UNBOUNDABLE:
+                findings.append(self.finding(
+                    module, node,
+                    f"{name}() cannot be bounded: under backpressure it "
+                    f"grows without limit — use queue.Queue(maxsize=N) "
+                    f"with put_nowait -> structured rejection instead"))
+                continue
+            if name not in self._BOUNDED_CLASSES:
+                continue
+            size = None
+            if node.args:
+                size = node.args[0]
+            for kw in node.keywords:
+                if kw.arg == "maxsize":
+                    size = kw.value
+            if size is None:
+                findings.append(self.finding(
+                    module, node,
+                    f"{name}() without maxsize is unbounded: a stalled "
+                    f"consumer lets it grow until OOM — pass "
+                    f"maxsize=<bound> and handle queue.Full as "
+                    f"backpressure (reject/drop), or justify with a "
+                    f"suppression"))
+            elif (isinstance(size, ast.Constant)
+                    and isinstance(size.value, int) and size.value <= 0):
+                findings.append(self.finding(
+                    module, node,
+                    f"{name}(maxsize={size.value}) is the unbounded "
+                    f"spelling (stdlib treats <=0 as infinite); pass a "
+                    f"positive bound"))
+        return findings
+
+
 def _iter_const_ints(node: ast.expr) -> Iterator[int]:
     if isinstance(node, ast.Constant) and isinstance(node.value, int):
         yield node.value

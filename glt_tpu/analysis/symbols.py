@@ -59,6 +59,9 @@ AMBIGUOUS_METHOD_NAMES = frozenset({
     "copy", "encode", "decode", "set", "is_set", "is_alive", "poll",
     "sample", "next", "sendall", "accept", "connect", "get_nowait",
     "put_nowait", "empty", "shutdown", "reset", "tolist", "item",
+    # jax.random.split / str.split / np.split: binding a project class's
+    # .split to these call sites invented host-sync effects (PR 9).
+    "split", "submit",
 })
 
 _RESOLVE_DEPTH = 8   # alias-chain / inheritance walk bound

@@ -181,7 +181,14 @@ class RemoteServerConnection:
 
     def _exchange(self, payload: bytes,
                   stop: Optional[threading.Event] = None,
-                  retries: Optional[int] = None):
+                  retries: Optional[int] = None,
+                  timeout: Optional[float] = None):
+        """One framed round trip; ``timeout`` is the PER-OP socket
+        timeout — latency-sensitive ops (serving ``subgraph_request``)
+        bound their wait tighter than the connection's ``rpc_timeout``
+        default without touching training-path fetches.  Applied per
+        attempt and restored afterwards, so the next op on this
+        connection sees the default again."""
         retries = self.max_retries if retries is None else int(retries)
         with self._lock:
             last_exc = None
@@ -213,6 +220,8 @@ class RemoteServerConnection:
                         # framed stream desynced; reconnecting is the only
                         # way to resync it.
                         self._connect()
+                    if timeout is not None:
+                        self.sock.settimeout(float(timeout))
                     # NTP sample half: t0 just before send, t3 just after
                     # a complete receive, both in the trace clock (only
                     # stamped while tracing — zero timestamp calls when
@@ -235,6 +244,12 @@ class RemoteServerConnection:
                             # us and is closing: retryable — a fresh
                             # connection resyncs the framing.
                             raise ProtocolError(resp.get("error", ""))
+                    if timeout is not None:
+                        # Restore the connection-wide default: later ops
+                        # on this socket get rpc_timeout semantics back.
+                        # (Failure paths mark the socket broken, and the
+                        # reconnect re-applies the default.)
+                        self.sock.settimeout(self.timeout)
                     return kind, data, t0, t3
                 except self.RETRYABLE as e:
                     self._broken = True
@@ -245,14 +260,24 @@ class RemoteServerConnection:
 
     @staticmethod
     def _raise_structured(resp: dict) -> None:
-        if resp.get("code") == "unknown_producer":
+        code = resp.get("code")
+        if code == "unknown_producer":
             raise UnknownProducerError(resp["error"])
+        if code is not None:
+            # Serving rejections round-trip as their typed exceptions
+            # (Overloaded keeps its retry_after_ms hint).  Local import:
+            # training-only deployments never touch glt_tpu.serving.
+            from ..serving.errors import SERVING_CODES, error_from_response
+
+            if code in SERVING_CODES:
+                raise error_from_response(resp)
         raise RuntimeError(f"server error: {resp['error']}")
 
     # -- protocol ----------------------------------------------------------
     def request(self, _stop: Optional[threading.Event] = None,
                 _retries: Optional[int] = None,
-                _trace_ctx: Optional[dict] = None, **req) -> dict:
+                _trace_ctx: Optional[dict] = None,
+                _timeout: Optional[float] = None, **req) -> dict:
         with _span("remote.request", op=str(req.get("op"))) as sp:
             if self.epoch_ctx:
                 sp.link(self.epoch_ctx.get("tid"),
@@ -266,7 +291,8 @@ class RemoteServerConnection:
             else:
                 _prop.inject(req, sp)
             kind, data, t0, t3 = self._exchange(
-                json.dumps(req).encode(), stop=_stop, retries=_retries)
+                json.dumps(req).encode(), stop=_stop, retries=_retries,
+                timeout=_timeout)
             if kind != _KIND_JSON:
                 raise RuntimeError("expected JSON response")
             resp = json.loads(data)

@@ -82,7 +82,11 @@ class RemoteSamplingWorkerOptions:
     # Socket timeout for every client<->server exchange (the reference's
     # rpc_timeout, dist_options.py:~90).  Generous default: a first XLA
     # compile on an oversubscribed host can stall the producer for
-    # minutes before the first batch lands.
+    # minutes before the first batch lands.  Latency-sensitive ops can
+    # override per request (`RemoteServerConnection.request(_timeout=)` /
+    # `_exchange(timeout=)`) without touching this training-path default
+    # — the serving InferenceClient derives its per-op timeout from each
+    # request's deadline.
     rpc_timeout: float = 600.0
     # -- fault tolerance (see docs/distributed.md "Fault tolerance") ----
     max_retries: int = 3

@@ -86,6 +86,9 @@ _H_REPLAY = _metrics.histogram(
 
 _KIND_JSON = 0
 _KIND_MSG = 1
+# Serving responses (glt_tpu.serving): one serialized per-request
+# SampleMessage, no sequence number — subgraph requests are stateless.
+_KIND_SUB = 2
 
 # Reject frames above this many payload bytes unless configured otherwise:
 # a corrupt (or hostile) u64 length must fail the frame, not drive an
@@ -104,11 +107,13 @@ class RequestError(RuntimeError):
     """A structured, per-request failure: reported to the client as
     ``{"error": ..., "code": ...}`` without closing the connection, so the
     client can distinguish e.g. a GC'd producer lease (``unknown_producer``)
-    from a crashed server."""
+    from a crashed server.  ``extra`` keys ride alongside in the error
+    response (the serving path's ``retry_after_ms`` backoff hint)."""
 
-    def __init__(self, message: str, code: str):
+    def __init__(self, message: str, code: str, **extra):
         super().__init__(message)
         self.code = code
+        self.extra = dict(extra)
 
 
 def send_frame(sock, kind: int, payload: bytes) -> None:
@@ -438,7 +443,8 @@ class DistServer:
                  reap_interval: float = 0.25,
                  fault_plan: Optional[FaultPlan] = None,
                  enable_metrics: bool = False,
-                 heartbeat_deadline: float = 10.0):
+                 heartbeat_deadline: float = 10.0,
+                 serving=None):
         from .dist_context import _set_default, make_server_context
         from .supervisor import Supervisor
 
@@ -470,6 +476,16 @@ class DistServer:
         # table.  Monitoring starts lazily with the first beat, so
         # heartbeat-free deployments pay nothing.
         self.supervisor = Supervisor(deadline_secs=heartbeat_deadline)
+        # Inference serving front (glt_tpu.serving, docs/serving.md):
+        # opt-in via init_server(serving=ServingOptions(...)).  The same
+        # framed protocol carries the latency path — `subgraph_request`
+        # answers with a _KIND_SUB frame, `serving_stats` with JSON.
+        self.serving = None
+        if serving is not None:
+            from ..serving.front import ServingFront
+
+            self.serving = ServingFront(dataset, serving,
+                                        fault_plan=fault_plan)
         self._producers: Dict[int, _Producer] = {}
         # client_key -> producer id: a client that reconnects and
         # re-creates (its lease expired, or it restarted) first tears
@@ -600,6 +616,13 @@ class DistServer:
         if op == "fleet_health":
             return {"peers": self.supervisor.status(),
                     "live_producers": self.live_producers()}
+        if op == "serving_stats":
+            # Occupancy + rejection counters of the serving front
+            # (docs/serving.md); enabled=False when serving is off so a
+            # probe never needs to catch an error.
+            if self.serving is None:
+                return {"enabled": False}
+            return {"enabled": True, **self.serving.stats()}
         if op == "get_metrics":
             # Prometheus-style text exposition (docs/observability.md):
             # a scrape sidecar (or a curl over the framed protocol) reads
@@ -624,6 +647,47 @@ class DistServer:
             self._stop.set()
             return {"ok": True}
         raise ValueError(f"unknown op {op!r}")
+
+    def _handle_subgraph(self, req: dict) -> bytes:
+        """Admit one serving request, wait for its coalesced result, and
+        return the serialized per-request SampleMessage.
+
+        Every failure mode is a :class:`RequestError` (connection stays
+        usable): serving disabled, admission rejection (``overloaded``
+        with a ``retry_after_ms`` hint), deadline miss, engine fault,
+        or a server-side wait-budget timeout."""
+        from ..serving.errors import ServingError
+
+        if self.serving is None:
+            raise RequestError(
+                "serving not enabled on this server; start it with "
+                "init_server(..., serving=ServingOptions(...))",
+                code="serving_disabled")
+        deadline_ms = req.get("deadline_ms")
+        try:
+            pending = self.serving.submit(req.get("seeds", ()),
+                                          deadline_ms=deadline_ms)
+        except ServingError as e:
+            raise RequestError(
+                str(e), code=e.code,
+                **({} if e.retry_after_ms is None
+                   else {"retry_after_ms": e.retry_after_ms})) from None
+        # Bounded wait (GLT007 discipline): the budget covers the
+        # request's own deadline plus a full queue's service time; a
+        # dispatcher wedged past that surfaces as a structured timeout,
+        # not a stuck connection thread.
+        if not pending.done.wait(
+                timeout=self.serving.wait_budget_s(deadline_ms)):
+            raise RequestError(
+                "serving request timed out server-side (dispatcher "
+                "overwhelmed or wedged)", code="serving_timeout")
+        if pending.error is not None:
+            e = pending.error
+            raise RequestError(
+                str(e), code=getattr(e, "code", "serving_failed"),
+                **({} if e.retry_after_ms is None
+                   else {"retry_after_ms": e.retry_after_ms}))
+        return serialize(pending.message)
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -682,6 +746,22 @@ class DistServer:
                             _H_REPLAY.observe(
                                 (time.perf_counter() - t_req0) * 1e3)
                         _M_MESSAGES.inc()
+                    elif req["op"] == "subgraph_request":
+                        # Latency path (glt_tpu.serving): this
+                        # connection thread blocks on ITS request's
+                        # completion only — the coalescer batches across
+                        # however many connection threads are waiting,
+                        # which is what makes the op multi-client safe.
+                        with _span("server.subgraph") as sp:
+                            if ctx:
+                                sp.link(ctx.get("tid"), ctx.get("sid"))
+                            frame = self._handle_subgraph(req)
+                            sp.set(bytes=len(frame))
+                            if ctx and tracer is not None:
+                                frame = _prop.pack_trailer(
+                                    frame, _prop.server_echo(
+                                        tracer, t_recv_us))
+                            send_frame(conn, _KIND_SUB, frame)
                     else:
                         with _span("server." + str(req["op"])) as sp:
                             if ctx:
@@ -698,7 +778,8 @@ class DistServer:
                     # in sync.
                     _M_ERRORS.inc()
                     send_frame(conn, _KIND_JSON, json.dumps(
-                        {"error": str(e), "code": e.code}).encode())
+                        {"error": str(e), "code": e.code,
+                         **e.extra}).encode())
         except Exception as e:  # desync/socket errors end the session
             # "protocol" marks a desynced stream: the client treats it as
             # retryable (reconnect resyncs framing, the replay window
@@ -717,6 +798,10 @@ class DistServer:
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self.serving is not None:
+            # Fail queued serving requests structurally before tearing
+            # down producers — their connection threads are waiting.
+            self.serving.stop()
         # Stop every live producer: with the mp backend each one owns a
         # worker-process fleet and a shm ring that would otherwise outlive
         # the client that forgot to destroy it.
@@ -741,7 +826,8 @@ def init_server(dataset, host: str = "127.0.0.1", port: int = 0,
                 reap_interval: float = 0.25,
                 fault_plan: Optional[FaultPlan] = None,
                 enable_metrics: bool = False,
-                heartbeat_deadline: float = 10.0) -> DistServer:
+                heartbeat_deadline: float = 10.0,
+                serving=None) -> DistServer:
     """Start a sampling server (cf. init_server, dist_server.py:158-190).
 
     Pass a picklable ``dataset_builder`` (+``builder_args``) to enable
@@ -756,6 +842,10 @@ def init_server(dataset, host: str = "127.0.0.1", port: int = 0,
     ``enable_metrics=True`` flips the process-wide
     :mod:`glt_tpu.obs.metrics` switch so the ``get_metrics`` op's
     Prometheus exposition carries live ``glt.server.*`` counters.
+    ``serving=ServingOptions(...)`` additionally mounts the inference
+    serving front (:mod:`glt_tpu.serving`, docs/serving.md): the
+    ``subgraph_request`` wire op with cross-request micro-batching,
+    admission control, and deadline-aware drop.
     """
     return DistServer(dataset, host=host, port=port,
                       dataset_builder=dataset_builder,
@@ -766,4 +856,5 @@ def init_server(dataset, host: str = "127.0.0.1", port: int = 0,
                       reap_interval=reap_interval,
                       fault_plan=fault_plan,
                       enable_metrics=enable_metrics,
-                      heartbeat_deadline=heartbeat_deadline)
+                      heartbeat_deadline=heartbeat_deadline,
+                      serving=serving)

@@ -57,6 +57,17 @@ EXPLICIT_DIRECTIONS: Dict[str, int] = {
     "overflow_rate": DOWN,
     "dist_routing_overhead": DOWN,
     "obs_noop_ns_per_call": DOWN,
+    # Serving SLO metrics (benchmarks/bench_serving.py, docs/serving.md):
+    # latency quantiles down-good, the coalescing win up-good.
+    "serving_p50_ms": DOWN,
+    "serving_p99_ms": DOWN,
+    "serving_p99_light_ms": DOWN,
+    "serving_single_ms": DOWN,
+    "serving_coalesce_speedup": UP,
+    "serving_rps_coalesced": UP,
+    "serving_rps_per_request": NEUTRAL,
+    "serving_overload_reject_frac": NEUTRAL,
+    "serving_offered_rps": NEUTRAL,
     # Environment / configuration readings — not better or worse.
     "tunnel_rtt_ms": NEUTRAL,
     "dedup_ratio": NEUTRAL,
@@ -102,6 +113,11 @@ ASPIRATIONS: Dict[str, Tuple[str, float]] = {
     # Preemption-safety must stay ~free at cadence N=50 (ISSUE 8's
     # acceptance bar; benchmarks/bench_resume.py emits the reading).
     "ckpt_overhead_frac": ("<=", 0.05),
+    # Serving acceptance bars (ISSUE 9): coalesced dispatch must beat
+    # per-request dispatch by >1.5x at saturating load, and the loaded
+    # p99 should stay interactive (tracked so a flat miss flags stuck).
+    "serving_coalesce_speedup": (">=", 1.5),
+    "serving_p99_ms": ("<=", 50.0),
 }
 
 

@@ -1,0 +1,54 @@
+"""glt_tpu.serving — low-latency multi-tenant inference serving.
+
+The "millions of users" half of the north star (ROADMAP item 3): many
+concurrent clients each request ego-subgraphs for small seed sets and
+get back the sampled batch (node ids, COO, edge ids, features) at
+interactive latency.  The throughput comes from **cross-request
+micro-batching**: a coalescer packs outstanding requests into one
+fixed-shape device batch (padding buckets, so no recompiles), runs the
+shared sample->dedup->gather program once, and scatters results back
+per client; admission control bounds inflight work and rejects overload
+with structured ``Overloaded`` + retry-after instead of queueing
+without bound.
+
+Layers (see docs/serving.md):
+  errors     typed structured errors + wire-code round-tripping
+  options    ServingOptions — coalescing policy + admission bounds
+  engine     SubgraphEngine — bucketed device programs + per-request split
+  front      ServingFront — admission queue + coalescing dispatcher
+  client     InferenceClient — thin request client w/ per-op timeouts
+
+Server side, pass ``init_server(dataset, serving=ServingOptions(...))``;
+the ``subgraph_request`` wire op and ``serving_stats`` live on the same
+framed protocol the training loaders use.
+"""
+from .client import InferenceClient
+from .engine import CoalescedSample, SubgraphEngine
+from .errors import (
+    BadRequest,
+    DeadlineExceeded,
+    Overloaded,
+    ServingDisabled,
+    ServingDown,
+    ServingError,
+    ServingTimeout,
+    error_from_response,
+)
+from .front import ServingFront
+from .options import ServingOptions
+
+__all__ = [
+    "BadRequest",
+    "CoalescedSample",
+    "DeadlineExceeded",
+    "InferenceClient",
+    "Overloaded",
+    "ServingDisabled",
+    "ServingDown",
+    "ServingError",
+    "ServingFront",
+    "ServingOptions",
+    "ServingTimeout",
+    "SubgraphEngine",
+    "error_from_response",
+]

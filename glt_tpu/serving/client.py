@@ -1,0 +1,135 @@
+"""InferenceClient: the thin latency-path client for subgraph serving.
+
+A :class:`~glt_tpu.distributed.dist_client.RemoteServerConnection`
+underneath (same framed protocol, reconnect/backoff/failover machinery),
+driven with serving-appropriate knobs: every ``subgraph`` round trip
+carries a **per-op socket timeout** derived from the request's deadline
+(the PR-9 per-op timeout seam — training fetches keep their generous
+``rpc_timeout``, serving ops fail fast), and structured server rejections
+surface as typed :mod:`glt_tpu.serving.errors` exceptions —
+``Overloaded`` with its ``retry_after_ms`` hint, ``DeadlineExceeded``,
+``BadRequest`` — never as retry loops hidden inside the client.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..channel.serialization import deserialize
+from ..distributed.dist_client import RemoteServerConnection
+from ..distributed.dist_server import _KIND_JSON, _KIND_SUB
+from ..distributed.sample_message import message_to_batch
+from ..obs import metrics as _metrics
+from ..obs import propagate as _prop
+from ..obs.trace import span as _span
+from .errors import ServingError
+
+_H_CLIENT = _metrics.histogram(
+    "glt.serving.client_ms",
+    "client-observed subgraph round trip (serialize+wire+serve)")
+
+
+class InferenceClient:
+    """Request ego-subgraphs from a serving-enabled ``DistServer``.
+
+    Args:
+      addr: server ``(host, port)``.
+      timeout: default per-request deadline budget, SECONDS — sent to
+        the server as ``deadline_ms`` (its drop-if-late SLO) and used to
+        derive the per-op socket timeout.
+      op_timeout_margin: added to the deadline for the socket timeout,
+        covering serialization + scheduling slack (and, on the very
+        first request per bucket, server-side compilation — raise it or
+        pre-warm via ``ServingOptions``/a throwaway request if cold
+        compiles exceed it).
+      max_retries: transport-level retries per exchange (reconnect +
+        resend).  Serving requests are stateless/idempotent server-side,
+        so a retried request at worst costs a wasted micro-batch slot.
+        Structured rejections (Overloaded etc.) are never retried here —
+        backoff policy belongs to the caller.
+      to_device: reconstruct batches as device arrays (training-style)
+        or host numpy (the default for serving consumers).
+    """
+
+    def __init__(self, addr: Tuple[int, int], timeout: float = 1.0,
+                 op_timeout_margin: float = 30.0,
+                 max_retries: int = 1,
+                 fallback_addrs: Sequence[Tuple[str, int]] = (),
+                 fault_plan=None, seed: int = 0,
+                 to_device: bool = False):
+        self.default_timeout = float(timeout)
+        self.op_timeout_margin = float(op_timeout_margin)
+        self.to_device = bool(to_device)
+        self._retries = int(max_retries)
+        self.conn = RemoteServerConnection(
+            addr, max_retries=max_retries,
+            fallback_addrs=tuple(fallback_addrs),
+            fault_plan=fault_plan, seed=seed)
+
+    def subgraph(self, seeds, timeout: Optional[float] = None):
+        """One ego-subgraph request; returns a
+        :class:`~glt_tpu.loader.transform.Batch` whose first
+        ``batch_size`` node slots are the (deduplicated) seeds.
+
+        Raises the typed serving errors on structured rejection and the
+        usual transport errors past the retry budget.
+        """
+        t = self.default_timeout if timeout is None else float(timeout)
+        req = {
+            "op": "subgraph_request",
+            "seeds": np.asarray(seeds).astype(np.int64).ravel().tolist(),
+            "deadline_ms": t * 1e3,
+        }
+        with _span("serving.client_request",
+                   seeds=len(req["seeds"])) as sp, _H_CLIENT.time():
+            _prop.inject(req, sp)
+            kind, data, t0, t3 = self.conn._exchange(
+                json.dumps(req).encode(), retries=self._retries,
+                timeout=t + self.op_timeout_margin)
+            if kind == _KIND_JSON:
+                resp = json.loads(data)
+                if "error" in resp:
+                    self.conn._raise_structured(resp)
+                raise RuntimeError(
+                    f"expected a subgraph frame, got JSON {resp!r}")
+            if kind != _KIND_SUB:
+                raise RuntimeError(f"unexpected frame kind {kind}")
+            if _prop.WIRE_KEY in req:
+                payload, echo = _prop.split_trailer(data)
+                _prop.record_clock_sync(echo, t0, t3)
+            else:
+                payload = memoryview(data)
+            msg = deserialize(payload)
+        return message_to_batch(msg, to_device=self.to_device)
+
+    def subgraph_with_retry(self, seeds, timeout: Optional[float] = None,
+                            attempts: int = 3,
+                            max_backoff_s: float = 0.5):
+        """``subgraph`` plus honor-the-hint backoff on ``Overloaded``.
+
+        The polite client loop the bench uses under deliberate
+        overload; any other serving error propagates immediately.
+        """
+        import time as _time
+
+        last: Optional[ServingError] = None
+        for _ in range(max(1, int(attempts))):
+            try:
+                return self.subgraph(seeds, timeout=timeout)
+            except ServingError as e:
+                if e.code != "overloaded":
+                    raise
+                last = e
+                hint = (e.retry_after_ms or 10.0) / 1e3
+                _time.sleep(min(max_backoff_s, hint))
+        raise last
+
+    def stats(self) -> dict:
+        """The server's ``serving_stats`` table (queue depth, rejection
+        counters, compiled buckets)."""
+        return self.conn.request(op="serving_stats")
+
+    def close(self) -> None:
+        self.conn.close()

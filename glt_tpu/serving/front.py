@@ -1,0 +1,298 @@
+"""The serving front: admission control + cross-request coalescer.
+
+Request lifecycle (all timings are ``glt.serving.*`` histograms,
+docs/observability.md):
+
+  submit -> [bounded inflight queue] -> coalesce -> micro-batch dispatch
+         -> per-request scatter -> complete (or a structured error)
+
+* **Admission** (:meth:`ServingFront.submit`): the inflight queue is
+  bounded at ``max_inflight``; a full queue rejects immediately with
+  :class:`~glt_tpu.serving.errors.Overloaded` carrying a
+  ``retry_after_ms`` hint derived from the measured micro-batch service
+  time — a 2x-overloaded server answers every request (mostly with
+  "later"), it never grows an unbounded backlog.
+
+* **Coalescing** (:meth:`_collect`): the dispatcher pops the first
+  pending request, then holds the micro-batch open up to ``max_wait_ms``
+  for co-riders, closing early when ``max_batch_requests`` requests or
+  the largest seed bucket fills.  Idle server: one request waits at most
+  ``max_wait_ms``.  Loaded server: batches fill instantly and the wait
+  never triggers — latency SLO and throughput come from the same knob.
+
+* **Deadline-aware drop**: a request still queued past its deadline is
+  completed with ``deadline_exceeded`` at dispatch time — the device
+  slot goes to a request someone is still waiting for.
+
+* **Fault containment**: an engine failure fails exactly the requests
+  of that micro-batch (structured ``serving_failed``); the dispatcher
+  thread survives and the next micro-batch is clean.  A client that
+  disconnects mid-coalesce costs its co-riders nothing — completion is
+  per-request, delivery failure is the dead connection's alone.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..obs import metrics as _metrics
+from ..obs.trace import span as _span
+from .engine import SubgraphEngine
+from .errors import DeadlineExceeded, Overloaded, ServingDown, ServingError
+from .options import ServingOptions
+
+# Serving metrics (docs/observability.md "glt.serving.*"): the SLO
+# window.  e2e covers submit->complete server-side; the client adds its
+# own glt.serving.client_ms around the wire round trip.
+_M_REQUESTS = _metrics.counter(
+    "glt.serving.requests", "subgraph requests admitted")
+_M_OVERLOAD = _metrics.counter(
+    "glt.serving.rejected_overload",
+    "requests rejected by admission control (queue full)")
+_M_DEADLINE = _metrics.counter(
+    "glt.serving.rejected_deadline",
+    "requests dropped after missing their deadline in queue")
+_M_FAILED = _metrics.counter(
+    "glt.serving.failed", "requests failed by an engine fault")
+_M_BATCHES = _metrics.counter(
+    "glt.serving.micro_batches", "coalesced micro-batches dispatched")
+_H_QUEUE_WAIT = _metrics.histogram(
+    "glt.serving.queue_wait_ms",
+    "submit -> coalescer pickup wait per request")
+_H_WIDTH = _metrics.histogram(
+    "glt.serving.coalesce_width", "requests per dispatched micro-batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+_H_SEEDS = _metrics.histogram(
+    "glt.serving.coalesce_seeds", "total seeds per micro-batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+_H_BATCH = _metrics.histogram(
+    "glt.serving.batch_ms",
+    "micro-batch device stage: sample+gather dispatch + host fetch")
+_H_SCATTER = _metrics.histogram(
+    "glt.serving.scatter_ms",
+    "micro-batch host stage: per-request split/relabel")
+_H_E2E = _metrics.histogram(
+    "glt.serving.e2e_ms", "submit -> completion per request, server-side")
+
+
+class _Pending:
+    """One inflight request: seeds in, message (or error) out."""
+
+    __slots__ = ("seeds", "deadline", "enqueued", "done", "message",
+                 "error")
+
+    def __init__(self, seeds: np.ndarray, deadline: Optional[float]):
+        self.seeds = seeds
+        self.deadline = deadline          # monotonic, None = no SLO
+        self.enqueued = time.monotonic()
+        self.done = threading.Event()
+        self.message = None
+        self.error: Optional[ServingError] = None
+
+    def succeed(self, message) -> None:
+        self.message = message
+        self.done.set()
+
+    def fail(self, error: ServingError) -> None:
+        self.error = error
+        self.done.set()
+
+
+class ServingFront:
+    """Admission + coalescing dispatcher over one :class:`SubgraphEngine`.
+
+    Thread-safe for submitters (many connection threads); the engine is
+    driven by the single dispatcher thread.
+    """
+
+    def __init__(self, dataset, options: ServingOptions,
+                 fault_plan=None, engine: Optional[SubgraphEngine] = None):
+        self.options = options
+        self.engine = engine or SubgraphEngine(dataset, options)
+        self._fault_plan = fault_plan
+        # The admission bound: submit() never blocks — a full queue is an
+        # immediate structured Overloaded, not a hidden stall.
+        self._queue: "queue.Queue[_Pending]" = queue.Queue(
+            maxsize=int(options.max_inflight))
+        self._carry: Optional[_Pending] = None
+        self._stop = threading.Event()
+        self._stats_lock = threading.Lock()
+        self._dispatched_batches = 0
+        self._completed = 0
+        self._failed = 0
+        self._rejected_overload = 0
+        self._rejected_deadline = 0
+        # EWMA of micro-batch service time, seeding the retry-after hint
+        # before the first batch lands (compile-heavy) with the wait knob.
+        self._ewma_batch_ms = max(10.0, 2.0 * float(options.max_wait_ms))
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="glt-serving-dispatch")
+        self._thread.start()
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, seeds, deadline_ms: Optional[float] = None) -> _Pending:
+        """Validate + admit one request; returns its :class:`_Pending`.
+
+        Raises :class:`BadRequest` / :class:`Overloaded` /
+        :class:`ServingDown` instead of queueing doomed work.
+        """
+        if self._stop.is_set() or not self._thread.is_alive():
+            raise ServingDown("serving front is stopped")
+        canonical = self.engine.validate_seeds(seeds)
+        if deadline_ms is None:
+            deadline_ms = self.options.default_deadline_ms
+        deadline = (None if deadline_ms is None or deadline_ms <= 0
+                    else time.monotonic() + float(deadline_ms) / 1e3)
+        pending = _Pending(canonical, deadline)
+        try:
+            self._queue.put_nowait(pending)
+        except queue.Full:
+            with self._stats_lock:
+                self._rejected_overload += 1
+            _M_OVERLOAD.inc()
+            raise Overloaded(
+                f"serving queue full ({self.options.max_inflight} "
+                f"inflight); retry after the hint",
+                retry_after_ms=self.retry_after_ms()) from None
+        _M_REQUESTS.inc()
+        return pending
+
+    def retry_after_ms(self) -> float:
+        """Backoff hint: how long until a queue slot should open —
+        the queue's depth in micro-batches times the measured batch
+        service time."""
+        depth_batches = 1 + (self._queue.qsize()
+                             // max(1, self.options.max_batch_requests))
+        return round(depth_batches * self._ewma_batch_ms, 3)
+
+    def wait_budget_s(self, deadline_ms: Optional[float]) -> float:
+        """Server-side wait bound for a connection thread blocked on a
+        pending result: the request's deadline budget plus one queue's
+        worth of service time (compile of a cold bucket rides inside —
+        the deadline clock, not this bound, is what drops it)."""
+        budget = (self.options.default_deadline_ms
+                  if deadline_ms is None else float(deadline_ms))
+        slack = (self._queue.maxsize + 1) * self._ewma_batch_ms + 1000.0
+        return (max(budget, 0.0) + slack) / 1e3
+
+    # -- coalescer ----------------------------------------------------------
+    def _collect(self) -> List[_Pending]:
+        """Pop one micro-batch: first pending request (bounded poll so
+        stop is observed), then co-riders until width/seed/wait limits."""
+        first = self._carry
+        self._carry = None
+        while first is None:
+            if self._stop.is_set():
+                return []
+            try:
+                first = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+        batch = [first]
+        total = first.seeds.size
+        max_bucket = self.engine.buckets[-1]
+        close_at = time.monotonic() + float(self.options.max_wait_ms) / 1e3
+        while len(batch) < self.options.max_batch_requests:
+            rem = close_at - time.monotonic()
+            if rem <= 0:
+                break
+            try:
+                nxt = self._queue.get(timeout=rem)
+            except queue.Empty:
+                break
+            if total + nxt.seeds.size > max_bucket:
+                # Does not fit this bucket: lead the next micro-batch.
+                self._carry = nxt
+                break
+            batch.append(nxt)
+            total += nxt.seeds.size
+        return batch
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            batch = self._collect()
+            if batch:
+                self._dispatch(batch)
+        # Drain on stop: everything still queued fails structurally.
+        leftovers, self._carry = [self._carry], None
+        while True:
+            try:
+                leftovers.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for p in leftovers:
+            if p is not None and not p.done.is_set():
+                p.fail(ServingDown("serving front stopped"))
+
+    def _dispatch(self, batch: List[_Pending]) -> None:
+        now = time.monotonic()
+        live: List[_Pending] = []
+        for p in batch:
+            _H_QUEUE_WAIT.observe((now - p.enqueued) * 1e3)
+            if p.deadline is not None and now > p.deadline:
+                with self._stats_lock:
+                    self._rejected_deadline += 1
+                _M_DEADLINE.inc()
+                p.fail(DeadlineExceeded(
+                    f"request spent {(now - p.enqueued) * 1e3:.1f} ms "
+                    f"queued, past its deadline; dropped undispatched"))
+                continue
+            live.append(p)
+        if not live:
+            return
+        _H_WIDTH.observe(len(live))
+        _H_SEEDS.observe(sum(p.seeds.size for p in live))
+        t0 = time.perf_counter()
+        try:
+            if self._fault_plan is not None:
+                self._fault_plan.on_serving_batch()
+            with _span("serving.micro_batch", width=len(live)):
+                with _H_BATCH.time():
+                    coal = self.engine.sample([p.seeds for p in live])
+                with _H_SCATTER.time():
+                    messages = self.engine.scatter(coal)
+        except Exception as e:  # noqa: BLE001 — relayed per request
+            # Engine fault: fail exactly this micro-batch's requests with
+            # a structured error; the dispatcher (and every later
+            # micro-batch) keeps serving.
+            with self._stats_lock:
+                self._failed += len(live)
+            _M_FAILED.inc(len(live))
+            for p in live:
+                p.fail(ServingError(f"serving engine failed: {e}"))
+            return
+        batch_ms = (time.perf_counter() - t0) * 1e3
+        self._ewma_batch_ms += 0.2 * (batch_ms - self._ewma_batch_ms)
+        done = time.monotonic()
+        for p, msg in zip(live, messages):
+            p.succeed(msg)
+            _H_E2E.observe((done - p.enqueued) * 1e3)
+        with self._stats_lock:
+            self._dispatched_batches += 1
+            self._completed += len(live)
+        _M_BATCHES.inc()
+
+    # -- introspection / lifecycle ------------------------------------------
+    def stats(self) -> dict:
+        """JSON-able occupancy/outcome counters (the ``serving_stats``
+        wire op; the bench reads rejection counts from here)."""
+        with self._stats_lock:
+            return {
+                "inflight": self._queue.qsize(),
+                "max_inflight": self._queue.maxsize,
+                "dispatched_batches": self._dispatched_batches,
+                "completed": self._completed,
+                "failed": self._failed,
+                "rejected_overload": self._rejected_overload,
+                "rejected_deadline": self._rejected_deadline,
+                "ewma_batch_ms": round(self._ewma_batch_ms, 3),
+                "compiled_buckets": self.engine.compiled_buckets(),
+            }
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30)

@@ -1,0 +1,81 @@
+"""Serving configuration: coalescing policy + admission bounds.
+
+One dataclass so ``init_server(serving=ServingOptions(...))`` carries the
+whole policy: which sampling program shapes exist (``seed_buckets`` —
+the static-shape buckets that keep XLA from recompiling per request
+width), how long an idle server waits to coalesce
+(``max_wait_ms`` — the latency/throughput dial), and how much inflight
+work admission control admits before rejecting with ``Overloaded``
+(``max_inflight`` — the bounded queue that keeps a 2x-overload server
+answering instead of growing without bound).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class ServingOptions:
+    """Policy knobs for the :mod:`glt_tpu.serving` front on a server.
+
+    Attributes:
+      num_neighbors: per-hop fanouts of the shared serving sampler (the
+        same shape every coalesced micro-batch runs).
+      seed_buckets: ascending padded seed-vector widths; a micro-batch
+        is padded to the smallest bucket holding its total seed count,
+        so the device sees one compiled program per bucket instead of
+        one per request mix.  The largest bucket bounds how many seeds
+        one dispatch can coalesce.
+      max_seeds_per_request: per-request seed-set bound (the 1-100-node
+        ego-subgraph contract); larger requests are rejected
+        ``bad_request`` — split them client-side.
+      max_batch_requests: at most this many requests share one
+        micro-batch (1 = per-request dispatch, the bench baseline).
+      max_wait_ms: how long the coalescer holds a non-full micro-batch
+        open for co-riders.  An idle server pays at most this much
+        extra latency; a loaded one never waits (the batch fills
+        first).
+      max_inflight: bound on queued-but-undispatched requests;
+        admission control rejects past it with a structured
+        ``Overloaded`` + ``retry_after_ms`` hint.
+      default_deadline_ms: per-request SLO budget when the client sends
+        none; a request still queued past its deadline is dropped with
+        ``deadline_exceeded`` instead of wasting a device slot.
+      with_features / with_labels: gather node features/labels into the
+        response (one shared gather per micro-batch — the cross-request
+        I/O coalescing win).
+      with_edge: include global edge ids in responses.
+      frontier_cap: optional per-hop frontier cap forwarded to the
+        sampler (memory knob for wide fanouts).
+      seed: base RNG seed for the serving samplers.
+    """
+
+    num_neighbors: Sequence[int] = (10, 5)
+    seed_buckets: Tuple[int, ...] = (8, 32, 128)
+    max_seeds_per_request: int = 100
+    max_batch_requests: int = 32
+    max_wait_ms: float = 2.0
+    max_inflight: int = 64
+    default_deadline_ms: float = 1000.0
+    with_features: bool = True
+    with_labels: bool = True
+    with_edge: bool = True
+    frontier_cap: Optional[int] = None
+    seed: int = 0
+
+    def __post_init__(self):
+        buckets = tuple(sorted(int(b) for b in self.seed_buckets))
+        if not buckets or any(b <= 0 for b in buckets):
+            raise ValueError(f"seed_buckets must be positive, got "
+                             f"{self.seed_buckets!r}")
+        self.seed_buckets = buckets
+        if int(self.max_seeds_per_request) > buckets[-1]:
+            raise ValueError(
+                f"max_seeds_per_request {self.max_seeds_per_request} "
+                f"exceeds the largest seed bucket {buckets[-1]}: a "
+                f"single admissible request must fit one micro-batch")
+        if int(self.max_batch_requests) < 1:
+            raise ValueError("max_batch_requests must be >= 1")
+        if int(self.max_inflight) < 1:
+            raise ValueError("max_inflight must be >= 1")

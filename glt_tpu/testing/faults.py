@@ -91,6 +91,11 @@ class FaultPlan:
     # Same point, but raise SimulatedPreemption instead of dying — the
     # in-process variant for the kill-at-every-k resume sweep.
     preempt_at_train_step: Optional[int] = None
+    # Fail the Nth serving micro-batch dispatch (1-based): the serving
+    # front's dispatcher sees an engine exception exactly when that
+    # coalesced batch would run, and must degrade to structured errors
+    # for THAT batch's requests only (no poisoning of later batches).
+    fail_serving_batch: Optional[int] = None
     # Only the first N accepted/established connections are faulty;
     # later ones run clean (lets a test end the weather deterministically).
     max_faulty_conns: Optional[int] = None
@@ -101,11 +106,13 @@ class FaultPlan:
         self._conns = 0
         self._puts = 0
         self._train_steps = 0
+        self._serving_batches = 0
         self.injected_drops = 0
         self.injected_failures = 0
         self.injected_corruptions = 0
         self.injected_delays = 0
         self.injected_preemptions = 0
+        self.injected_serving_failures = 0
 
     # -- endpoint hooks ----------------------------------------------------
     def wrap(self, sock: socket.socket):
@@ -151,6 +158,22 @@ class FaultPlan:
             raise SimulatedPreemption(
                 f"fault injection: process preempted after {n} "
                 f"train steps")
+
+    def on_serving_batch(self) -> None:
+        """Called by the serving dispatcher before each micro-batch
+        (``fail_serving_batch``).  Raises a plain RuntimeError — the
+        engine-crash class the front must contain to the one batch."""
+        if self.fail_serving_batch is None:
+            return
+        with self._lock:
+            self._serving_batches += 1
+            fire = self._serving_batches == self.fail_serving_batch
+            if fire:
+                self.injected_serving_failures += 1
+        if fire:
+            raise RuntimeError(
+                f"fault injection: serving engine crashed on micro-batch "
+                f"{self.fail_serving_batch}")
 
     @property
     def connections(self) -> int:

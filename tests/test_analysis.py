@@ -714,6 +714,71 @@ class TestNonAtomicStatePublish:
 
 
 # ---------------------------------------------------------------------------
+# GLT012 unbounded-queue-put
+# ---------------------------------------------------------------------------
+
+class TestUnboundedQueuePut:
+    def test_positive_bare_queue(self):
+        src = """
+        import queue
+
+        def make_buffer():
+            return queue.Queue()
+        """
+        fs = findings_for(src, "unbounded-queue-put")
+        assert len(fs) == 1 and "maxsize" in fs[0].message
+
+    def test_positive_from_import_and_zero_maxsize(self):
+        src = """
+        from queue import Queue
+
+        buf = Queue(maxsize=0)
+        lifo = Queue(0)
+        """
+        assert len(findings_for(src, "unbounded-queue-put")) == 2
+
+    def test_positive_simplequeue(self):
+        src = """
+        import queue
+
+        q = queue.SimpleQueue()
+        """
+        fs = findings_for(src, "unbounded-queue-put")
+        assert len(fs) == 1 and "cannot be bounded" in fs[0].message
+
+    def test_negative_bounded_spellings(self):
+        src = """
+        import queue
+        from queue import Queue
+
+        a = queue.Queue(maxsize=8)
+        b = Queue(16)
+        c = queue.LifoQueue(maxsize=4)
+        d = queue.Queue(maxsize=capacity)   # dynamic bound: trusted
+        """
+        assert findings_for(src, "unbounded-queue-put") == []
+
+    def test_negative_multiprocessing_out_of_scope(self):
+        src = """
+        import multiprocessing as mp
+
+        def make_task_queue(ctx):
+            return ctx.Queue()
+
+        q = mp.Queue()
+        """
+        assert findings_for(src, "unbounded-queue-put") == []
+
+    def test_suppression(self):
+        src = """
+        import queue
+
+        q = queue.Queue()  # gltlint: disable=unbounded-queue-put
+        """
+        assert findings_for(src, "unbounded-queue-put") == []
+
+
+# ---------------------------------------------------------------------------
 # the project engine: symbols, call graph, effects
 # ---------------------------------------------------------------------------
 
@@ -1324,6 +1389,7 @@ def test_rule_registry_complete():
         "shadowed-jit-donation", "unbounded-blocking-get",
         "lock-order-inversion", "blocking-call-while-holding-lock",
         "span-in-traced-code", "non-atomic-state-publish",
+        "unbounded-queue-put",
     }
 
 

@@ -49,9 +49,22 @@ class TestDirections:
         ("tunnel_rtt_ms", NEUTRAL),
         ("node_cap_calibrated", NEUTRAL),
         ("occupancy_p99", NEUTRAL),
+        ("serving_p99_ms", DOWN),
+        ("serving_p50_ms", DOWN),
+        ("serving_coalesce_speedup", UP),
+        ("serving_rps_coalesced", UP),
+        ("serving_overload_reject_frac", NEUTRAL),
     ])
     def test_direction_table(self, metric, expected):
         assert direction(metric) == expected
+
+    def test_serving_aspirations_registered(self):
+        from glt_tpu.obs.regress import ASPIRATIONS
+
+        op, target = ASPIRATIONS["serving_coalesce_speedup"]
+        assert op == ">=" and target >= 1.5
+        op, target = ASPIRATIONS["serving_p99_ms"]
+        assert op == "<="
 
 
 class TestCompare:
