@@ -1,8 +1,8 @@
 """Supervised GraphSAGE on a REAL dataset: the sklearn digits k-NN graph.
 
 Config-1's EXACT pipeline (the code path of train_sage_products.py —
-NeighborSampler, occupancy auto-cap, bf16 matmuls, fused pipelined train
-step) on real features/labels: 1797 handwritten-digit images, 64 raw
+NeighborSampler, occupancy auto-cap, bf16 matmuls, fused scanned-epoch
+train step) on real features/labels: 1797 handwritten-digit images, 64 raw
 pixel features, 10 classes, symmetric 8-NN graph
 (scripts/make_digits_graph.py; the data ships in-repo under
 data/digits-knn).  Reports held-out test accuracy against the non-graph
@@ -32,8 +32,8 @@ from glt_tpu.models import (
     GraphSAGE,
     TrainState,
     make_eval_step,
-    make_pipelined_train_step,
-    run_pipelined_epoch,
+    make_scanned_node_train_step,
+    run_scanned_epoch,
 )
 from glt_tpu.sampler import NeighborSampler, calibrate_node_capacity
 from examples.train_sage_products import seed_batches
@@ -48,6 +48,8 @@ def main():
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--bf16", action=argparse.BooleanOptionalAction,
                     default=True)
+    ap.add_argument("--group", type=int, default=4,
+                    help="batches per fused scan-group program")
     ap.add_argument("--auto-cap", action=argparse.BooleanOptionalAction,
                     default=True)
     ap.add_argument("--data-root", default=None)
@@ -98,22 +100,22 @@ def main():
     params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
     state = TrainState(params=params, opt_state=tx.init(params),
                        step=jax.numpy.zeros((), jax.numpy.int32))
-    step, sample_first = make_pipelined_train_step(
+    # The fused scanned epoch (the only compiled epoch driver): G
+    # consecutive sample->gather->train batches per XLA program.
+    step = make_scanned_node_train_step(
         model, tx, sampler, feat, labels, args.batch_size)
     rng = np.random.default_rng(0)
 
     for epoch in range(args.epochs):
         t0 = time.perf_counter()
-        state, losses, accs = run_pipelined_epoch(
-            step, sample_first,
-            seed_batches(train_idx, args.batch_size, rng),
-            state, jax.random.PRNGKey(100 + epoch))
-        jax.device_get(losses[-1])
+        state, losses, accs, _ovf = run_scanned_epoch(
+            step, state, train_idx, args.batch_size, args.group, rng,
+            jax.random.PRNGKey(100 + epoch))
         dt = time.perf_counter() - t0
         if epoch % 5 == 0 or epoch == args.epochs - 1:
             print(f"epoch {epoch}: "
-                  f"loss={float(np.mean(jax.device_get(losses))):.4f} "
-                  f"train_acc={float(np.mean(jax.device_get(accs))):.4f} "
+                  f"loss={float(np.mean(losses)):.4f} "
+                  f"train_acc={float(np.mean(accs)):.4f} "
                   f"time={dt:.2f}s")
 
     # Held-out accuracy through the SAME sampling pipeline (eval mode).

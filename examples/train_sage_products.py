@@ -22,9 +22,7 @@ from glt_tpu.loader import NeighborLoader
 from glt_tpu.models import (
     GraphSAGE,
     create_train_state,
-    make_pipelined_train_step,
     make_train_step,
-    run_pipelined_epoch,
 )
 from glt_tpu.sampler import NeighborSampler
 
@@ -64,17 +62,16 @@ def main():
     # precision; loss-curve parity asserted in tests/test_models.py.
     ap.add_argument("--bf16", action=argparse.BooleanOptionalAction,
                     default=True)
-    # Fused "train k + sample k+1" single-program pipeline (default);
-    # --no-pipelined runs the two-program loader path.
-    ap.add_argument("--pipelined", action=argparse.BooleanOptionalAction,
-                    default=True)
-    # G-batch scan (DEFAULT): one program trains --group consecutive
-    # batches (sample+gather+fwd/bwd+update under lax.scan) — amortises
-    # host dispatch + seed feeds; equivalence tested exactly
+    # Fused scanned epoch (DEFAULT, the only compiled epoch driver —
+    # the overlapped "train k + sample k+1" path was deleted after three
+    # rounds at 0.97-0.99x; see glt_tpu/models/train.py docstring): one
+    # program trains --group consecutive batches (sample+gather+fwd/bwd+
+    # update under lax.scan) — amortises host dispatch + seed feeds;
+    # equivalence tested exactly
     # (tests/test_models.py::test_scanned_node_step_matches_serial).
-    # Measured on TPU: 9.39 s/epoch vs 10.27 s fused (BENCH r5).
     ap.add_argument("--group", type=int, default=8,
-                    help="scan G batches per program (0 = fused pipeline)")
+                    help="scan G batches per program (0 = eager "
+                         "two-program loader loop)")
     # Exact final-hop dedup is the default; --no-last-hop-dedup opts into
     # the leaf-block fast mode (tree-unrolled GraphSAGE semantics).
     ap.add_argument("--last-hop-dedup",
@@ -120,7 +117,6 @@ def main():
             node_cap = None
 
     def build_sampler_and_state():
-        """Shared by the --group and pipelined branches."""
         from glt_tpu.models import TrainState
 
         sampler = probe if (probe is not None and node_cap is None) else \
@@ -160,26 +156,6 @@ def main():
             if ovf:
                 print(f"  overflow batches: {ovf}/{len(losses)}")
             return state, list(losses), list(accs)
-    elif args.pipelined:
-        sampler, feat, labels, state = build_sampler_and_state()
-        step, sample_first = make_pipelined_train_step(
-            model, tx, sampler, feat, labels, args.batch_size)
-        rng = np.random.default_rng(0)
-
-        def run_epoch(state, epoch):
-            stats = {} if sampler.capped else None
-            res = run_pipelined_epoch(
-                step, sample_first,
-                seed_batches(train_idx, args.batch_size, rng),
-                state, jax.random.PRNGKey(100 + epoch), stats=stats)
-            if stats and stats.get("overflow_flags"):
-                ovf = int(np.asarray(
-                    jax.device_get(jax.numpy.stack(
-                        stats["overflow_flags"]))).sum())
-                if ovf:
-                    print(f"  overflow batches: {ovf}/"
-                          f"{len(stats['overflow_flags'])}")
-            return res
     else:
         loader = NeighborLoader(ds, args.fanout, train_idx,
                                 batch_size=args.batch_size, shuffle=True,

@@ -1202,6 +1202,113 @@ class UnboundedQueuePut(Rule):
         return findings
 
 
+# ---------------------------------------------------------------------------
+# GLT013 dispatch-in-epoch-loop
+# ---------------------------------------------------------------------------
+
+@register
+class DispatchInEpochLoop(Rule):
+    """Per-batch host round-trips inside an epoch driver's batch loop.
+
+    The fused-epoch contract (glt_tpu/models/train.py "The fused
+    epoch"): an epoch driver dispatches compiled programs and fetches
+    device values ONCE at the epoch boundary — a device->host fetch
+    (``jax.device_get`` / ``np.asarray`` / ``.item()`` /
+    ``block_until_ready`` / ``int()``/``float()`` coercions) inside the
+    per-batch loop puts a tunnel round trip on every batch's critical
+    path and silently reverts the scanned route to serialized per-batch
+    latency (the 161 ms/batch vs 49 ms pipelined split bench.py
+    documents).  This is the static guard that keeps the fusion win
+    from regressing.
+
+    Scope (calibrated on this tree): ``for``/``while`` bodies of
+    functions named ``run_*epoch*`` — the epoch-driver naming
+    convention (``run_scanned_epoch``, ``run_scanned_dist_epoch``,
+    ``_ColdStagePipeline.run_epoch``).  Direct fetches are always
+    flagged; with a project, calls into helpers whose effect summary
+    reaches a host sync are flagged too (the round trip hidden one call
+    deep).  Deliberate syncs — a checkpoint hook that must capture
+    post-block-exact state — carry a justified suppression.
+    """
+    name = "dispatch-in-epoch-loop"
+    code = "GLT013"
+    severity = Severity.ERROR
+    description = ("device->host fetch inside an epoch driver's batch "
+                   "loop (per-batch tunnel round trip on the critical "
+                   "path)")
+
+    _EPOCH_NAME = "epoch"
+    _EPOCH_PREFIXES = ("run_", "_run_")
+    _FETCH_CALLS = (set(HOST_SYNC_CALLS)
+                    | {"jax.block_until_ready", "jax.device_get"})
+
+    @classmethod
+    def _is_epoch_driver(cls, name: str) -> bool:
+        return (cls._EPOCH_NAME in name
+                and name.startswith(cls._EPOCH_PREFIXES))
+
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
+        findings: List[Finding] = []
+        for scope in module.scopes:
+            if not self._is_epoch_driver(scope.name):
+                continue
+            for loop in _walk_own(scope.node):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                for node in ast.walk(loop):
+                    if isinstance(node, ast.Call):
+                        f = self._check_call(module, scope, node, project)
+                        if f is not None:
+                            findings.append(f)
+        return findings
+
+    def _check_call(self, module: ModuleInfo, scope, call: ast.Call,
+                    project) -> Optional[Finding]:
+        name = module.call_name(call)
+        if name in self._FETCH_CALLS:
+            return self.finding(
+                module, call,
+                f"'{name}' inside the batch loop of epoch driver "
+                f"'{scope.name}' fetches device state every batch — "
+                f"accumulate device values and fetch ONCE after the "
+                f"loop (one concat + one host read), or justify with a "
+                f"suppression")
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in SYNC_METHODS):
+            return self.finding(
+                module, call,
+                f".{call.func.attr}() inside the batch loop of epoch "
+                f"driver '{scope.name}' is a per-batch device sync — "
+                f"hoist the fetch out of the loop or justify with a "
+                f"suppression")
+        if name in COERCIONS and call.args \
+                and not isinstance(call.args[0], ast.Constant):
+            return self.finding(
+                module, call,
+                f"'{name}(...)' inside the batch loop of epoch driver "
+                f"'{scope.name}': coercing a device value is a blocking "
+                f"fetch per batch — keep losses as device arrays and "
+                f"reduce once after the loop")
+        # One call deep: a helper whose effect summary reaches a host
+        # sync (project-wide pass only).
+        if project is not None:
+            sym = project.resolve_call(module, scope, call)
+            if isinstance(sym, FunctionSymbol):
+                summary = project.effects.summary_for(sym)
+                sync = summary.sync_param_map()
+                if sync:
+                    p, site = next(iter(sorted(sync.items())))
+                    return self.finding(
+                        module, call,
+                        f"'{sym.short}' called in the batch loop of "
+                        f"epoch driver '{scope.name}' reaches a host "
+                        f"sync through parameter '{p}' "
+                        f"({sym.module.path}:{site.line}) — a hidden "
+                        f"per-batch round trip; fetch after the epoch "
+                        f"instead")
+        return None
+
+
 def _iter_const_ints(node: ast.expr) -> Iterator[int]:
     if isinstance(node, ast.Constant) and isinstance(node.value, int):
         yield node.value

@@ -67,18 +67,27 @@ def cache_init(num_ids: int, capacity: int, dim: int,
     )
 
 
-def cache_lookup(state: FeatureCacheState, ids: jnp.ndarray):
+def cache_lookup(state: FeatureCacheState, ids: jnp.ndarray,
+                 force: str = "auto"):
     """Probe the cache for ``ids`` (-1 = padding).  jit-safe, read-only.
 
     Returns ``(rows, hit)``: ``[M, d]`` rows (zeros at misses/padding)
-    and the ``[M]`` bool hit mask.
+    and the ``[M]`` bool hit mask.  The hit read is itself a random row
+    gather over the ``[C, d]`` cache table, so it routes through the
+    same autotuned kernel seam as the backing-store gather
+    (:func:`~glt_tpu.ops.gather_pallas.gather_rows`, ``force``) — a
+    cache that serves most of a batch must not hand the saved HBM
+    traffic back as unoptimized table reads.
     """
+    from ..ops.gather_pallas import gather_rows
+
     n = state.id2slot.shape[0] - 2
     valid = ids >= 0
     slot = state.id2slot[jnp.where(valid, jnp.clip(ids, 0, n - 1), n)]
     hit = valid & (slot >= 0)
     c_dump = state.table.shape[0] - 1
-    rows = jnp.take(state.table, jnp.where(hit, slot, c_dump), axis=0)
+    rows = gather_rows(state.table, jnp.where(hit, slot, c_dump),
+                       force=force)
     return jnp.where(hit[:, None], rows, 0), hit
 
 
@@ -116,7 +125,8 @@ def cache_insert(state: FeatureCacheState, ids: jnp.ndarray,
 
 
 def cache_gather(state: FeatureCacheState, ids: jnp.ndarray,
-                 fetch: Callable[[jnp.ndarray], jnp.ndarray]):
+                 fetch: Callable[[jnp.ndarray], jnp.ndarray],
+                 force: str = "auto"):
     """Serve UNIQUE ``ids`` through the cache; fetch misses via ``fetch``.
 
     ``fetch(masked_ids) -> [M, d]`` gathers from the backing store with
@@ -125,12 +135,14 @@ def cache_gather(state: FeatureCacheState, ids: jnp.ndarray,
     touched for true misses.  Returns ``(state', rows)`` with the
     freshly fetched rows inserted and counters bumped.  jit-safe; thread
     ``state`` through your scan carry / donated step arguments.
+    ``force`` selects the hit-read gather kernel (see
+    :func:`cache_lookup`).
 
     ``ids`` MUST be duplicate-free among its valid entries (route through
     :func:`~glt_tpu.ops.unique.unique_first_occurrence` first — the dedup
     gather already has) or resident rows may be double-inserted.
     """
-    rows_hit, hit = cache_lookup(state, ids)
+    rows_hit, hit = cache_lookup(state, ids, force=force)
     miss = (ids >= 0) & ~hit
     fetched = fetch(jnp.where(miss, ids, -1))
     rows = jnp.where(hit[:, None], rows_hit, fetched.astype(rows_hit.dtype))

@@ -88,10 +88,15 @@ class NodeLoader:
         self._autotune_row_gather()
 
     def _autotune_row_gather(self) -> None:
-        """Warmup A/B of the row-gather kernel (XLA vs tiled-DMA Pallas)
-        for this loader's gather shape, memoized per (row width, batch,
-        dtype) — ``gather_rows(force='auto')`` then serves every
-        ``_collate_fn`` with the measured winner.  No-op off TPU and for
+        """Warmup sweep of the row-gather kernel (XLA vs the tiled-DMA
+        Pallas (tile_rows, ring_depth) grid) for this loader's gather
+        shape, memoized per (row width, batch, dtype) —
+        ``gather_rows(force='auto')`` then serves every ``_collate_fn``
+        with the measured winner.  The probe is built at THIS sampler's
+        ``node_capacity``, so an occupancy-capped loader sweeps its own
+        (smaller) shape instead of inheriting a full-cap winner whose
+        tile/padding choice may lose there (the BENCH_r05
+        ``gather_ms_capped`` inversion).  No-op off TPU and for
         tiered/absent features (their gathers are host-side stages)."""
         feat = self.data.get_node_feature() if self.data is not None else None
         cap = getattr(self.sampler, "node_capacity", None)
@@ -159,7 +164,7 @@ class NodeLoader:
                             _M_SAMPLE_MS.time():
                         out = self.sampler.sample_from_nodes(
                             NodeSamplerInput(seeds))
-                    # Deferred-flag pattern (cf. run_pipelined_epoch):
+                    # Deferred-flag pattern (cf. run_scanned_epoch):
                     # start the flag's D2H copy at dispatch so the
                     # strict check at pop time resolves a transfer that
                     # overlapped the prefetch window instead of paying a

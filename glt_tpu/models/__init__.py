@@ -10,7 +10,6 @@ from .train import (
     link_seed_blocks,
     make_cached_gather_xy,
     make_gather_xy,
-    make_pipelined_train_step,
     init_hetero_state,
     make_scanned_hetero_train_step,
     make_scanned_link_train_step,
@@ -19,7 +18,6 @@ from .train import (
     run_scanned_epoch,
     make_scanned_subgraph_train_step,
     make_train_step,
-    run_pipelined_epoch,
     seed_cross_entropy,
 )
 
@@ -38,7 +36,6 @@ __all__ = [
     "make_cached_gather_xy",
     "make_eval_step",
     "make_gather_xy",
-    "make_pipelined_train_step",
     "init_hetero_state",
     "make_scanned_hetero_train_step",
     "make_scanned_link_train_step",
@@ -47,7 +44,6 @@ __all__ = [
     "run_scanned_epoch",
     "make_scanned_subgraph_train_step",
     "make_train_step",
-    "run_pipelined_epoch",
     "scatter_mean",
     "scatter_sum",
     "seed_cross_entropy",

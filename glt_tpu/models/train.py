@@ -5,6 +5,21 @@ The reference leaves training loops to user PyTorch code
 framework so the whole batch -> loss -> grad -> update path is one XLA
 program.  Loss is masked cross-entropy over the **seed rows only** — seeds
 occupy ``node[:batch_size]`` by the sampler's first-occurrence contract.
+
+**The fused epoch.**  The canonical epoch driver is the *scanned* path
+(:func:`make_scanned_node_train_step` + :func:`run_scanned_epoch`):
+sample -> dedup -> gather -> fwd/bwd -> update for ``G`` consecutive
+batches compiles as ONE XLA program per scan group, so intermediate ids
+never round-trip through host dispatch and per-batch host work drops to
+one seed-block feed per ``G`` batches.  An earlier "overlapped" driver
+(``make_pipelined_train_step`` — one program fusing "train batch k"
+with "sample batch k+1") was DELETED in the gather-wall round: three
+bench rounds measured ``overlap_speedup`` at 0.97-0.99, because both
+halves of the fused program contend for the same HBM bandwidth — the
+gather-dominated step has no idle resource for sampling to hide in.
+The scanned route beat it honestly (BENCH_r05: 9.35 s vs 10.01 s per
+config-1 epoch) and carries the same resume/cache/donation seams, so
+the losing path is gone rather than reported at 0.99 a fourth time.
 """
 from __future__ import annotations
 
@@ -26,10 +41,7 @@ from ..typing import PADDING_ID
 _M_STEPS = _metrics.counter(
     "glt.train.steps", "train steps dispatched by the epoch drivers")
 _M_EPOCHS = _metrics.counter(
-    "glt.train.epochs", "epochs driven (pipelined + scanned)")
-_M_STEP_MS = _metrics.histogram(
-    "glt.train.step_dispatch_ms",
-    "per-step host dispatch wall in the pipelined epoch driver")
+    "glt.train.epochs", "scanned epochs driven")
 
 
 class TrainState(NamedTuple):
@@ -149,7 +161,7 @@ def make_cached_gather_xy(id2index=None, force: str = "auto"):
             return jnp.where(v[:, None],
                              gather_rows(rows_arg, fidx, force), 0)
 
-        cache, urows = cache_gather(cache, uniq, fetch)
+        cache, urows = cache_gather(cache, uniq, fetch, force=force)
         x = jnp.take(urows, jnp.clip(inv, 0, inv.shape[0] - 1), axis=0)
         x = jnp.where((inv >= 0)[:, None], x, 0)
         valid = ids >= 0
@@ -175,225 +187,10 @@ def _check_cache(feature_cache, rows_dtype, dim):
             f"feature_cache dim {feature_cache.dim} != feature dim {dim}")
 
 
-def make_pipelined_train_step(model, tx, sampler, rows, labels,
-                              batch_size: int, dropout_seed: int = 0,
-                              dedup: bool = False, feature_cache=None):
-    """Fuse "train batch k" with "sample batch k+1" into ONE XLA program.
-
-    The reference hides sampling latency behind training with up to 32
-    concurrent in-flight batches per CPU/GPU worker
-    (distributed/dist_neighbor_sampler.py:88-174, dist_options.py:21-100).
-    On TPU both stages run on the same chip, so concurrency can't come
-    from extra workers — it comes from the compiler: inside one program
-    the sampler's gather/DMA chains carry no data dependency on the train
-    step's matmuls, so XLA's scheduler interleaves HBM traffic for batch
-    ``k+1``'s sampling with MXU work for batch ``k``'s fwd/bwd instead of
-    running the two phases back-to-back (the serial two-program layout).
-
-    Args:
-      sampler: a :class:`~glt_tpu.sampler.neighbor_sampler.NeighborSampler`
-        (its pure ``_sample_impl`` is traced into the fused program).
-      rows: ``[N, d]`` device-resident feature matrix (config-1 layout:
-        products features fit HBM whole).
-      labels: ``[N]`` int device array.
-
-    Returns ``(step, sample_first)``:
-      * ``sample_first(seeds, key) -> out`` — jitted prologue for batch 0;
-      * ``step(state, out_k, seeds_k1, key_k1) -> (state, loss, acc,
-        out_k1)`` — one fused program; pass ``seeds_k1=None``'s stand-in
-        (any batch, e.g. the first) for the epilogue call and drop its
-        ``out``.
-
-    ``dedup=True`` switches the in-jit feature gather to the dedup-aware
-    path (bit-identical ``x``).  ``feature_cache`` (a
-    :class:`~glt_tpu.data.feature_cache.FeatureCacheState` built with the
-    rows' dtype/width) additionally serves unique ids through the
-    cross-batch HBM cache; the state is threaded through the step
-    internally (its buffers are DONATED — the object passed in is
-    invalid after the first call; read the live one via
-    ``step.feature_cache()``).
-    """
-    import numpy as np
-
-    from ..data.feature import Feature
-
-    g = sampler.graph
-    labels = jnp.asarray(labels)
-    if not isinstance(rows, Feature):
-        rows = Feature(np.asarray(rows))
-    if rows.hot_count < rows.size:
-        raise ValueError(
-            "pipelined step needs a fully device-resident Feature "
-            "(split_ratio=1.0); use the tiered pipeline for host tiers")
-    feature = rows
-    hot_rows = feature.hot_rows
-    if feature_cache is not None:
-        _check_cache(feature_cache, hot_rows.dtype, hot_rows.shape[-1])
-        cached_xy = make_cached_gather_xy(feature.id2index)
-    gather_xy = make_gather_xy(feature.id2index, dedup=dedup)
-
-    # Graph arrays ride as jit arguments (they may be host numpy or, on a
-    # mesh, process-spanning global arrays — neither may be closed over).
-    # The sampler's own jitted program serves as the prologue — no second
-    # compilation of the identical sampling executable.
-    def sample_first(seeds, key):
-        return sampler._sample_jit(g.indptr, g.indices, g.gather_edge_ids,
-                                   jnp.asarray(seeds, jnp.int32), key)
-
-    def _train_half(rows_arg, labels_arg, state, out_prev, cache):
-        """Shared train half; ``cache`` is None or a FeatureCacheState."""
-        if cache is None:
-            x, y = gather_xy(rows_arg, labels_arg, out_prev)
-        else:
-            cache, x, y = cached_xy(cache, rows_arg, labels_arg, out_prev)
-        edge_index = jnp.stack([out_prev.row, out_prev.col])
-        rng = jax.random.fold_in(jax.random.PRNGKey(dropout_seed),
-                                 state.step)
-
-        def loss_fn(params):
-            logits = model.apply(params, x, edge_index,
-                                 out_prev.edge_mask, train=True,
-                                 rngs={"dropout": rng})
-            return seed_cross_entropy(logits, y, batch_size,
-                                      out_prev.node_mask)
-
-        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return TrainState(params, opt_state, state.step + 1), loss, acc, cache
-
-    # out_prev's buffers are dead after the train half: donate them so the
-    # next batch's SamplerOutput reuses the allocation.  Feature rows and
-    # labels ride as jit ARGUMENTS: closure-captured device arrays of this
-    # size would be re-marshalled per compile (and may not be closed over
-    # at all on a multi-host mesh).
-    @partial(jax.jit, donate_argnums=(6,))
-    def _step(indptr, indices, eids, rows_arg, labels_arg,
-              state: TrainState, out_prev, seeds_next, key_next):
-        out_next = sampler._sample_impl(indptr, indices, eids, seeds_next,
-                                        key_next)
-        state, loss, acc, _ = _train_half(rows_arg, labels_arg, state,
-                                          out_prev, None)
-        return state, loss, acc, out_next
-
-    # Cache variant: the cache state rides (and is donated) alongside
-    # out_prev so the HBM table updates in place batch-to-batch.
-    @partial(jax.jit, donate_argnums=(6, 9))
-    def _step_cached(indptr, indices, eids, rows_arg, labels_arg,
-                     state: TrainState, out_prev, seeds_next, key_next,
-                     cache):
-        out_next = sampler._sample_impl(indptr, indices, eids, seeds_next,
-                                        key_next)
-        state, loss, acc, cache = _train_half(rows_arg, labels_arg, state,
-                                              out_prev, cache)
-        return state, loss, acc, out_next, cache
-
-    cache_holder = {"cache": feature_cache}
-
-    def step(state: TrainState, out_prev, seeds_next, key_next):
-        if out_prev.metadata is not None:
-            # Strip metadata (the occupancy-cap overflow flag) from the
-            # donated pytree so a caller-retained reference survives the
-            # donation (run_pipelined_epoch collects the flags and fetches
-            # them once per epoch).
-            import dataclasses as _dc
-
-            out_prev = _dc.replace(out_prev, metadata=None)
-        args = (g.indptr, g.indices, g.gather_edge_ids, hot_rows,
-                labels, state, out_prev,
-                jnp.asarray(seeds_next, jnp.int32), key_next)
-        if cache_holder["cache"] is None:
-            return _step(*args)
-        state, loss, acc, out_next, cache_holder["cache"] = _step_cached(
-            *args, cache_holder["cache"])
-        return state, loss, acc, out_next
-
-    # Live cache accessor (None when no cache was attached): feed it to
-    # data.feature_cache.cache_stats for the hit/miss counters.
-    step.feature_cache = lambda: cache_holder["cache"]
-    return step, sample_first
-
-
-def run_pipelined_epoch(step, sample_first, seed_batches, state,
-                        base_key, stats: dict = None,
-                        start_batch: int = 0, on_step=None) -> tuple:
-    """Drive one epoch of the fused pipeline.
-
-    ``seed_batches``: iterable of ``[batch_size]`` int32 device/host seed
-    arrays.  Returns ``(state, losses, accs)`` — device scalars, unsynced,
-    one per batch (every batch is trained exactly once; the final batch's
-    train half runs in an epilogue step whose sample half re-samples batch
-    0 and is discarded).
-
-    ``start_batch``/``on_step`` are the resume seam (glt_tpu.ckpt):
-    batch ``i`` always samples under ``fold_in(base_key, i)`` — pure in
-    its absolute position — so skipping the first ``start_batch``
-    batches of the same deterministic schedule replays the identical
-    remaining stream (the epilogue's re-sample half is discarded, so its
-    seed source moving from batch 0 to batch ``start_batch`` changes no
-    trained value).  ``on_step(state, i)`` fires after batch ``i``'s
-    train half DISPATCHES (unsynced — a checkpoint capture's own host
-    fetch is the sync; see ckpt.state.capture_pytree).
-
-    ``stats``: optional dict; with an occupancy-capped sampler,
-    ``stats['overflow_flags']`` collects each batch's device overflow
-    scalar (no per-batch sync — fetch after the epoch and report the
-    rate; overflow batches trained with their excess-node edges masked).
-
-    With tracing enabled (``obs.start_trace()``) the epoch span fences
-    on the last loss — ONE extra device sync at epoch end so the trace
-    records real completion, not the last enqueue.  Per-step spans
-    measure dispatch only and never sync.
-    """
-    import jax.numpy as jnp
-
-    losses, accs = [], []
-    flags = None if stats is None else stats.setdefault("overflow_flags", [])
-    out = None
-    first = None
-    with _span("train.pipelined_epoch") as ep:
-        for i, seeds in enumerate(seed_batches):
-            if i < start_batch:
-                continue
-            seeds = jnp.asarray(seeds)
-            k = jax.random.fold_in(base_key, i)
-            if out is None:
-                out = sample_first(seeds, k)
-                first = seeds
-                continue
-            if flags is not None and out.metadata:
-                flags.append(out.metadata.get("overflow"))
-            with _span("train.step_dispatch"), _M_STEP_MS.time():
-                state, loss, acc, out = step(state, out, seeds, k)
-            _M_STEPS.inc()
-            losses.append(loss)
-            accs.append(acc)
-            if on_step is not None:
-                on_step(state, i - 1)
-        if out is not None:
-            if flags is not None and out.metadata:
-                flags.append(out.metadata.get("overflow"))
-            with _span("train.step_dispatch"), _M_STEP_MS.time():
-                state, loss, acc, _ = step(
-                    state, out, first,
-                    jax.random.fold_in(base_key, 2**31 - 1))
-            _M_STEPS.inc()
-            losses.append(loss)
-            accs.append(acc)
-            if on_step is not None:
-                on_step(state, start_batch + len(losses) - 1)
-        if losses:
-            # The epoch span closes on real device completion, not on the
-            # dispatch of the last enqueue (bench.py:33 tunnel caveat).
-            ep.fence(losses[-1])
-    _M_EPOCHS.inc()
-    return state, losses, accs
-
-
 def make_scanned_node_train_step(model, tx, sampler, rows, labels,
                                  batch_size: int, dropout_seed: int = 0,
-                                 dedup: bool = False, feature_cache=None):
+                                 dedup: bool = False, feature_cache=None,
+                                 gather_force: str = "auto"):
     """ONE jitted program trains ``G`` consecutive seed-node batches.
 
     The supervised-node analog of :func:`make_scanned_link_train_step`:
@@ -417,6 +214,11 @@ def make_scanned_node_train_step(model, tx, sampler, rows, labels,
     path; ``feature_cache`` threads a cross-batch HBM cache through the
     scan carry AND across blocks (buffers donated — read the live state
     via ``step.feature_cache()``).  Both leave ``x`` bit-identical.
+    ``gather_force`` pins the row-gather kernel inside the fused program
+    ('auto' serves the :func:`~glt_tpu.ops.gather_pallas.
+    autotune_gather_rows` winner for this table/batch shape — autotune
+    at the CAPPED shape before building the step so the fused gather
+    runs the tile/ring point measured for its own batch size).
     """
     import numpy as np
 
@@ -431,8 +233,10 @@ def make_scanned_node_train_step(model, tx, sampler, rows, labels,
     hot_rows = rows.hot_rows
     if feature_cache is not None:
         _check_cache(feature_cache, hot_rows.dtype, hot_rows.shape[-1])
-        cached_xy = make_cached_gather_xy(rows.id2index)
-    gather_xy = make_gather_xy(rows.id2index, dedup=dedup)
+        cached_xy = make_cached_gather_xy(rows.id2index,
+                                          force=gather_force)
+    gather_xy = make_gather_xy(rows.id2index, dedup=dedup,
+                               force=gather_force)
 
     @partial(jax.jit, donate_argnums=(6,))
     def run(indptr, indices, eids, rows_arg, labels_arg,
@@ -567,6 +371,9 @@ def run_scanned_epoch(step, state, train_idx, batch_size: int,
                 # (dispatch is async; a capture of an in-flight state
                 # would still be *correct* — device_get syncs — but the
                 # explicit wait keeps save timing honest in traces).
+                # The sync is the hook's contract, not an accidental
+                # per-batch fetch (GLT013 fires only when a hook is set).
+                # gltlint: disable-next=dispatch-in-epoch-loop
                 jax.block_until_ready(state)
                 on_block(state, i)
         _M_EPOCHS.inc()

@@ -1,5 +1,10 @@
 from .dedup_gather import dedup_counts, dedup_gather_rows
-from .gather_pallas import autotune_gather_rows, gather_rows, gather_rows_pallas
+from .gather_pallas import (
+    autotune_gather_rows,
+    autotune_table,
+    gather_rows,
+    gather_rows_pallas,
+)
 from .neighbor_sample import NeighborOutput, lookup_degrees, sample_neighbors
 from .negative_sample import NegativeSampleOutput, edge_in_csr, sample_negative_edges
 from .stitch import stitch_sample_results
@@ -13,5 +18,5 @@ __all__ = [
     "SubGraphOutput", "node_subgraph",
     "UniqueResult", "relabel_by_reference", "unique_first_occurrence",
     "dedup_counts", "dedup_gather_rows",
-    "autotune_gather_rows", "gather_rows", "gather_rows_pallas",
+    "autotune_gather_rows", "autotune_table", "gather_rows", "gather_rows_pallas",
 ]

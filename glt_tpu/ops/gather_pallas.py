@@ -4,33 +4,47 @@ TPU counterpart of the reference's ``GatherTensorKernel``
 (csrc/cuda/unified_tensor.cu:48-81): there, one warp copies each requested
 row from GPU/peer/pinned-host memory.
 
-Two generations of kernel live here:
+Three generations of kernel live here (two as lessons, one current):
 
-* **round 3 (retired design, kept as the lesson):** one async DMA per
-  requested row, ``_LAG``-deep pipelined.  Measured honestly (device-synced
-  timing) XLA's native gather beat it ~2x at 512B rows (4.6 vs 9.8 ms per
-  102400-row gather on the v5-lite chip): per-row DMAs are **issue-rate
-  bound**, not bandwidth bound — the bench's ``est_hbm_fraction`` of 0.0005
-  says the gather path moves <0.1% of HBM peak, so issuing the same number
-  of DMAs faster was never going to win.
+* **round 3 (retired):** one async DMA per requested row, pipelined.
+  Measured honestly, XLA's native gather beat it ~2x at 512B rows:
+  per-row DMAs are **issue-rate bound**, not bandwidth bound.
 
-* **tiled (current):** the win is in **coalescing**, not issue rate.  The
-  index list is sorted (XLA prologue), mapped onto aligned ``_TILE``-row
-  blocks of the table, and each *distinct* block is fetched with ONE
-  block DMA into a ``_NBUF``-deep ring of VMEM tile buffers (double
-  buffering generalised to ``_NBUF`` slots, ``_NBUF - 1`` DMAs in flight
-  while rows of the current tile are copied out).  Rows are emitted in
-  sorted order and un-permuted by an XLA epilogue gather.  Hotness-ordered
-  feature stores (:func:`~glt_tpu.data.reorder.sort_by_in_degree`) cluster
-  a batch's unique ids near the head of the table, so sorted runs share
-  tiles and one 4-16KB DMA serves many rows — the DMA count drops by the
-  clustering factor and each DMA is deep enough to stream.
+* **round 5 (superseded):** fixed 8-row tiles, fixed 8-slot ring.  It
+  coalesced sorted runs into block DMAs, but every DMA was 4KB at d=128
+  — deep enough to beat per-row issue, far too shallow to stream: the
+  bench read 0.05% of HBM bandwidth and ``gather_ms`` stayed the
+  dominant step cost (BENCH_r05: 81 ms vs 36.5 ms train).
 
-``gather_rows(force='auto')`` stays the A/B seam: it consults a per-(row
-width, batch, dtype) decision table filled by :func:`autotune_gather_rows`
-at warmup (eager, fetch-synced timing — ``block_until_ready`` lies under
-the axon tunnel, see bench.py) and falls back to XLA's gather wherever the
-kernel's shape constraints don't hold or no measurement exists.
+* **tiled, parameterized (current):** the same sorted-run coalescing,
+  but the two knobs that set DMA depth and overlap are now free
+  parameters swept by the autotuner:
+
+    - ``tile_rows`` — table rows per block DMA.  Bigger tiles amortize
+      DMA setup and stream deeper; the width-specialized defaults hold
+      the DMA *byte* depth roughly constant (~16KB) across row widths,
+      so d=64 tables use 32-row tiles where d=256 uses 16.
+    - ``ring_depth`` — VMEM tile slots == DMAs in flight.  The copy
+      ring is double-buffered in the general sense: while rows of tile
+      ``j`` are copied out to the output block, the DMAs for tiles
+      ``j+1 .. j+ring_depth-1`` are already streaming.
+
+  Width specialization also covers **d=64** (the common "half-lane"
+  embedding width): the table is viewed as ``[N/2, 128]`` paired rows,
+  the kernel moves full 128-lane rows (the lanes a 64-wide DMA would
+  pad to anyway), and an XLA epilogue selects the requested half.
+
+``gather_rows(force='auto')`` stays the A/B seam: it consults a
+per-(row width, batch, dtype) decision table filled by
+:func:`autotune_gather_rows` at warmup (eager, fetch-synced timing —
+``block_until_ready`` lies under the axon tunnel, see bench.py).  The
+autotuner now sweeps the (tile_rows, ring_depth) grid per shape and
+memoizes the winning *parameters*, not just the kernel choice; the
+full sweep table is exported (:func:`autotune_table`) so bench.py can
+publish the per-(width, tile, ring) landscape.  Because the table is
+keyed by the exact batch size, an occupancy-capped loader shape gets
+its own sweep instead of inheriting the full-cap winner (the
+BENCH_r05 ``gather_ms_capped`` > ``gather_ms`` inversion).
 """
 from __future__ import annotations
 
@@ -44,22 +58,55 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_TILE = 8     # table rows per block DMA (8 x 512B = 4KB at d=128 f32)
-_CHUNK = 256  # output rows per grid step
-_NBUF = 8     # VMEM tile buffers == max DMAs in flight
+_CHUNK = 256  # output rows per grid step (batch padded to a multiple)
+_MIN_TILE = 8
 
-# Decision table for force='auto': (d, b, dtype) -> 'xla' | 'pallas',
-# filled by autotune_gather_rows (eager warmup only — a traced call can
+# Decision table for force='auto': (d, b, dtype) ->
+#   ("xla", None) | ("pallas", (tile_rows, ring_depth)).
+# Filled by autotune_gather_rows (eager warmup only — a traced call can
 # not time anything, it just reads this table).
 _AUTO: dict = {}
+# Per-key sweep timings for the bench's autotune table:
+# (d, b, dtype) -> {"xla": ms, "t8_r4": ms, ...}.
+_AUTO_TIMES: dict = {}
 
 
-def _plan_tiled(idx: jnp.ndarray, n: int):
+def _sublane_min(dtype) -> int:
+    """Smallest legal second-to-last tile dim for this dtype (f32 8,
+    bf16 16, int8/fp8 32 — pallas_guide.md 'Tiling Constraints')."""
+    size = jnp.dtype(dtype).itemsize
+    return max(_MIN_TILE, 32 // max(size, 1))
+
+
+def default_gather_params(d: int, dtype=jnp.float32) -> tuple:
+    """Width-specialized (tile_rows, ring_depth) defaults.
+
+    Holds DMA depth near 16KB per block across row widths — the depth
+    where a v5-class DMA engine streams instead of paying setup per
+    transfer — and keeps enough ring slots for ~2 tiles of copy-out
+    latency to hide behind in-flight DMAs.
+    """
+    row_bytes = max(int(d) * jnp.dtype(dtype).itemsize, 1)
+    tile = max(_sublane_min(dtype), min(32, (1 << 14) // row_bytes))
+    tile = max(_MIN_TILE, (tile // _MIN_TILE) * _MIN_TILE)
+    return tile, 8
+
+
+def candidate_gather_params(d: int, dtype=jnp.float32) -> list:
+    """The (tile_rows, ring_depth) grid :func:`autotune_gather_rows`
+    sweeps for one shape.  Small by design: 3 tile depths x 2 ring
+    depths, pruned to legal sublane multiples for the dtype."""
+    lo = _sublane_min(dtype)
+    tiles = sorted({t for t in (8, 16, 32) if t >= lo})
+    return [(t, r) for t in tiles for r in (4, 8)]
+
+
+def _plan_tiled(idx: jnp.ndarray, n: int, tile: int):
     """XLA prologue: sort ids and coalesce them into aligned tile DMAs.
 
-    Returns static-shape descriptor arrays for :func:`gather_rows_pallas`:
+    Returns static-shape descriptor arrays for the kernel:
       order     [B]  sorted position -> original position
-      dstart    [G, _CHUNK] first table row of each DMA (-chunk-local slot)
+      dstart    [G, _CHUNK] first table row of each DMA
       row_lo/hi [G, _CHUNK] chunk-relative sorted-row range served per DMA
       ndma      [G]  live DMA count per chunk
       off       [B]  row offset of each sorted row inside its tile
@@ -70,7 +117,7 @@ def _plan_tiled(idx: jnp.ndarray, n: int):
     order = jnp.argsort(idx, stable=True)
     sidx = idx[order]
     # Aligned tiles, clamped so the block DMA never overruns the table.
-    dstart_row = jnp.clip((sidx // _TILE) * _TILE, 0, n - _TILE)
+    dstart_row = jnp.clip((sidx // tile) * tile, 0, n - tile)
     off = (sidx - dstart_row).astype(jnp.int32)
 
     r = jnp.arange(b, dtype=jnp.int32)
@@ -98,70 +145,67 @@ def _plan_tiled(idx: jnp.ndarray, n: int):
     return order, dstart, row_lo, row_hi, ndma, off
 
 
-def _tiled_kernel(dstart_ref, row_lo_ref, row_hi_ref, ndma_ref, off_ref,
-                  table_ref, out_ref, tiles, sems):
-    c = pl.program_id(0)
-    nd = ndma_ref[c]
+def _make_tiled_kernel(tile: int, nbuf: int):
+    """Kernel body over a (tile_rows, ring_depth) parameter point."""
 
-    def dma(j):
-        slot = lax.rem(j, _NBUF)
-        start = dstart_ref[c, j]
-        return pltpu.make_async_copy(
-            table_ref.at[pl.ds(start, _TILE)], tiles.at[slot],
-            sems.at[slot])
+    def kernel(dstart_ref, row_lo_ref, row_hi_ref, ndma_ref, off_ref,
+               table_ref, out_ref, tiles, sems):
+        c = pl.program_id(0)
+        nd = ndma_ref[c]
 
-    # Fill the pipeline: up to _NBUF block DMAs in flight.
-    for k in range(_NBUF):
-        @pl.when(k < nd)
-        def _():
-            dma(k).start()
+        def dma(j):
+            slot = lax.rem(j, nbuf)
+            start = dstart_ref[c, j]
+            return pltpu.make_async_copy(
+                table_ref.at[pl.ds(start, tile)], tiles.at[slot],
+                sems.at[slot])
 
-    def body(j, _):
-        slot = lax.rem(j, _NBUF)
-        dma(j).wait()
-        lo = row_lo_ref[c, j]
-        hi = row_hi_ref[c, j]
+        # Fill the ring: up to `nbuf` block DMAs in flight before the
+        # first copy-out touches a buffer.
+        for k in range(nbuf):
+            @pl.when(k < nd)
+            def _():
+                dma(k).start()
 
-        def copy_row(s, _):
-            o = off_ref[c * _CHUNK + s]
-            row = pl.load(tiles, (slot, pl.ds(o, 1), slice(None)))
-            pl.store(out_ref, (pl.ds(s, 1), slice(None)), row)
+        def body(j, _):
+            slot = lax.rem(j, nbuf)
+            dma(j).wait()
+            lo = row_lo_ref[c, j]
+            hi = row_hi_ref[c, j]
+
+            def copy_row(s, _):
+                o = off_ref[c * _CHUNK + s]
+                row = pl.load(tiles, (slot, pl.ds(o, 1), slice(None)))
+                pl.store(out_ref, (pl.ds(s, 1), slice(None)), row)
+                return _
+
+            lax.fori_loop(lo, hi, copy_row, None)
+            # Only after this tile's rows are consumed may its buffer
+            # slot be reissued (slot j % nbuf == slot (j + nbuf) % nbuf):
+            # the next tile's DMA streams while later tiles copy out.
+            @pl.when(j + nbuf < nd)
+            def _():
+                dma(j + nbuf).start()
             return _
 
-        lax.fori_loop(lo, hi, copy_row, None)
-        # Only after this tile's rows are consumed may its buffer slot be
-        # reissued (slot j % _NBUF == slot (j + _NBUF) % _NBUF).
-        @pl.when(j + _NBUF < nd)
-        def _():
-            dma(j + _NBUF).start()
-        return _
+        lax.fori_loop(0, nd, body, None)
 
-    lax.fori_loop(0, nd, body, None)
+    return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def gather_rows_pallas(table: jnp.ndarray, idx: jnp.ndarray,
-                       interpret: bool = False) -> jnp.ndarray:
-    """Gather ``table[idx]`` via coalesced block DMAs.
+@functools.partial(jax.jit,
+                   static_argnames=("interpret", "tile_rows", "ring_depth"))
+def _gather_sorted_pallas(table, idx_p, interpret, tile_rows, ring_depth):
+    """Core call: gather clip(idx_p) from a lane-aligned table.
 
-    Args:
-      table: ``[N, d]`` feature matrix (HBM-resident), ``N >= 8``,
-        ``d % 128 == 0``.
-      idx: ``[B]`` int32 row ids; out-of-range/negative ids are clamped
-        (callers mask padding rows).  ``B`` is padded internally to a
-        multiple of 256.
+    ``idx_p`` is already padded to a _CHUNK multiple; returns rows in
+    the ORIGINAL (unsorted) order.  ``table`` last dim must be a
+    multiple of 128.
     """
-    b = idx.shape[0]
+    bp = idx_p.shape[0]
     n, d = table.shape
-    if d % 128 != 0:
-        raise ValueError(f"dim {d} must be a multiple of 128")
-    if n < _TILE:
-        raise ValueError(f"table rows {n} must be >= {_TILE}")
-    bp = -(-b // _CHUNK) * _CHUNK
-    idx_p = jnp.concatenate(
-        [idx.astype(jnp.int32), jnp.zeros((bp - b,), jnp.int32)])
-
-    order, dstart, row_lo, row_hi, ndma, off = _plan_tiled(idx_p, n)
+    order, dstart, row_lo, row_hi, ndma, off = _plan_tiled(
+        idx_p, n, tile_rows)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(bp // _CHUNK,),
@@ -169,12 +213,12 @@ def gather_rows_pallas(table: jnp.ndarray, idx: jnp.ndarray,
         out_specs=pl.BlockSpec((_CHUNK, d), lambda c, *_: (c, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((_NBUF, _TILE, d), table.dtype),
-            pltpu.SemaphoreType.DMA((_NBUF,)),
+            pltpu.VMEM((ring_depth, tile_rows, d), table.dtype),
+            pltpu.SemaphoreType.DMA((ring_depth,)),
         ],
     )
     sorted_out = pl.pallas_call(
-        _tiled_kernel,
+        _make_tiled_kernel(tile_rows, ring_depth),
         out_shape=jax.ShapeDtypeStruct((bp, d), table.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
@@ -183,64 +227,177 @@ def gather_rows_pallas(table: jnp.ndarray, idx: jnp.ndarray,
     # Un-permute: sorted row k belongs at original position order[k].
     inv = (jnp.zeros((bp,), jnp.int32)
            .at[order].set(jnp.arange(bp, dtype=jnp.int32)))
-    return jnp.take(sorted_out, inv[:b], axis=0)
+    return jnp.take(sorted_out, inv, axis=0)
+
+
+def gather_rows_pallas(table: jnp.ndarray, idx: jnp.ndarray,
+                       interpret: bool = False,
+                       tile_rows: int = None,
+                       ring_depth: int = None) -> jnp.ndarray:
+    """Gather ``table[idx]`` via coalesced block DMAs.
+
+    Args:
+      table: ``[N, d]`` feature matrix (HBM-resident).  ``d % 128 == 0``
+        runs natively; ``d == 64`` runs through the paired-row view
+        (``N`` must be even); other widths raise.  ``N >= tile_rows``.
+      idx: ``[B]`` int32 row ids; out-of-range/negative ids are clamped
+        (callers mask padding rows).  ``B`` is padded internally to a
+        multiple of 256.
+      tile_rows / ring_depth: DMA tile depth and copy-ring slots; None
+        picks the width-specialized default
+        (:func:`default_gather_params`).
+    """
+    b = idx.shape[0]
+    n, d = table.shape
+    # NOTE: tile_rows/ring_depth are static Python ints (jit static
+    # args) — no coercions here, so the transitive host-sync analysis
+    # (GLT001) sees this body as jnp-pure from every traced caller.
+    if tile_rows is None or ring_depth is None:
+        dt, dr = default_gather_params(d if d % 128 == 0 else 128,
+                                       table.dtype)
+        if tile_rows is None:
+            # Defaults adapt to tiny tables: the deepest legal tile not
+            # exceeding the table height (explicit tile_rows still
+            # raises past the table — the autotuner relies on that).
+            rows = n if d % 128 == 0 else n // 2
+            tile_rows = max(_MIN_TILE,
+                            min(dt, (rows // _MIN_TILE) * _MIN_TILE))
+        if ring_depth is None:
+            ring_depth = dr
+    bp = -(-b // _CHUNK) * _CHUNK
+    idx_p = jnp.concatenate(
+        [idx.astype(jnp.int32), jnp.zeros((bp - b,), jnp.int32)])
+
+    if d % 128 == 0:
+        if n < tile_rows:
+            raise ValueError(f"table rows {n} must be >= {tile_rows}")
+        out = _gather_sorted_pallas(table, idx_p, interpret, tile_rows,
+                                    ring_depth)
+        return out[:b]
+    if d == 64:
+        # Paired-row view: [N/2, 128].  The kernel moves full 128-lane
+        # rows (a 64-lane DMA pads to 128 lanes in VMEM anyway); the
+        # epilogue selects the requested half per original position.
+        if n % 2 != 0:
+            raise ValueError(f"d=64 path needs an even row count, got {n}")
+        if n // 2 < tile_rows:
+            raise ValueError(
+                f"paired table rows {n // 2} must be >= {tile_rows}")
+        idx_c = jnp.clip(idx_p, 0, n - 1)
+        paired = _gather_sorted_pallas(table.reshape(n // 2, 128),
+                                       idx_c // 2, interpret, tile_rows,
+                                       ring_depth)
+        half = jnp.take_along_axis(
+            paired.reshape(bp, 2, 64),
+            (idx_c % 2)[:, None, None], axis=1)[:, 0]
+        return half[:b]
+    raise ValueError(f"dim {d} must be a multiple of 128 (or exactly 64)")
 
 
 def _xla_gather(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     return jnp.take(table, jnp.clip(idx, 0, table.shape[0] - 1), axis=0)
 
 
-def pallas_gather_supported(table, idx) -> bool:
+def pallas_gather_supported(table, idx, tile_rows: int = _MIN_TILE) -> bool:
     """Shape constraints of the tiled kernel (dtype-agnostic)."""
-    return table.shape[1] % 128 == 0 and table.shape[0] >= _TILE
+    n, d = table.shape
+    if d % 128 == 0:
+        return n >= tile_rows
+    return d == 64 and n % 2 == 0 and n // 2 >= tile_rows
 
 
 def _auto_key(table, idx):
     return (int(table.shape[1]), int(idx.shape[0]), str(table.dtype))
 
 
+def _fmt_params(params) -> str:
+    return "xla" if params is None else f"t{params[0]}_r{params[1]}"
+
+
 def autotune_gather_rows(table: jnp.ndarray, idx: jnp.ndarray,
                          iters: int = 3) -> str:
-    """Measure XLA vs the tiled kernel for this (row width, batch, dtype)
-    and memoize the winner for ``gather_rows(force='auto')``.
+    """Sweep XLA vs the tiled kernel's (tile_rows, ring_depth) grid for
+    this (row width, batch, dtype) and memoize the winner for
+    ``gather_rows(force='auto')``.
 
     Call EAGERLY at warmup (loader construction / bench setup) — never
     from inside a trace.  Timing is fetch-synced (a host scalar fetch is
     the only sync that provably waits under the axon tunnel; see
     bench.py).  Off-TPU backends and unsupported shapes pin 'xla'.
+
+    Returns ``'pallas'`` or ``'xla'`` (the per-candidate landscape is
+    kept in :func:`autotune_table`).  The key includes the exact batch
+    size, so an occupancy-capped shape is swept on its own rather than
+    inheriting the full-cap winner.
     """
     key = _auto_key(table, idx)
     if key in _AUTO:
-        return _AUTO[key]
-    choice = "xla"
+        return "xla" if _AUTO[key] is None else "pallas"
+    winner = None          # None = xla; else (tile_rows, ring_depth)
+    times: dict = {}
     if (jax.default_backend() == "tpu"
             and pallas_gather_supported(table, idx)):
-        try:
-            def timed(fn):
-                float(fn(table, idx)[0, 0])  # compile + warm
-                t0 = time.perf_counter()
-                for _ in range(iters):
-                    out = fn(table, idx)
-                float(out[0, 0])             # fetch = true sync
-                return time.perf_counter() - t0
+        def timed(fn):
+            float(fn(table, idx)[0, 0])  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(table, idx)
+            float(out[0, 0])             # fetch = true sync
+            return (time.perf_counter() - t0) / iters * 1e3
 
-            t_xla = timed(_xla_gather)
-            t_pal = timed(gather_rows_pallas)
-            choice = "pallas" if t_pal < t_xla else "xla"
+        try:
+            best = times["xla"] = timed(_xla_gather)
+            for params in candidate_gather_params(table.shape[1],
+                                                  table.dtype):
+                if not pallas_gather_supported(table, idx, params[0]):
+                    continue
+                try:
+                    t = timed(functools.partial(
+                        gather_rows_pallas, tile_rows=params[0],
+                        ring_depth=params[1]))
+                except Exception:  # pragma: no cover - params bad on chip
+                    continue
+                times[_fmt_params(params)] = t
+                if t < best:
+                    best, winner = t, params
         except Exception:  # pragma: no cover - kernel unsupported on chip
-            choice = "xla"
-    _AUTO[key] = choice
+            winner = None
+    _AUTO[key] = winner
+    _AUTO_TIMES[key] = times
+    choice = "xla" if winner is None else "pallas"
     # Autotune runs host-side at warmup (never under trace — GLT010), so
     # the kernel decision is safe to publish here.
     from ..obs import metrics as _metrics
 
     _metrics.counter("glt.gather.autotune_runs",
-                     "gather kernel A/B warmups").inc()
+                     "gather kernel sweep warmups").inc()
     _metrics.gauge("glt.gather.pallas_selected",
                    "1 if the last gather autotune picked the tiled "
                    "Pallas kernel", labels={"d": str(key[0])},
                    ).set(1.0 if choice == "pallas" else 0.0)
     return choice
+
+
+def autotune_table() -> dict:
+    """The sweep landscape, JSON-ready: ``{"d128_b139264_float32":
+    {"winner": "t32_r8", "ms": {"xla": 4.1, "t8_r4": ...}}, ...}``.
+    Empty entries mean the shape was pinned to XLA without a sweep
+    (off-TPU or unsupported)."""
+    out = {}
+    for key, winner in _AUTO.items():
+        d, b, dt = key
+        out[f"d{d}_b{b}_{dt}"] = {
+            "winner": _fmt_params(winner),
+            "ms": {k: round(v, 4)
+                   for k, v in _AUTO_TIMES.get(key, {}).items()},
+        }
+    return out
+
+
+def reset_autotune() -> None:
+    """Drop all memoized decisions (tests / re-calibration)."""
+    _AUTO.clear()
+    _AUTO_TIMES.clear()
 
 
 def gather_rows(table: jnp.ndarray, idx: jnp.ndarray,
@@ -249,13 +406,21 @@ def gather_rows(table: jnp.ndarray, idx: jnp.ndarray,
 
     force: 'auto' | 'pallas' | 'xla'.  'auto' reads the decision table
     filled by :func:`autotune_gather_rows` (XLA until a measurement
-    exists).  The ``GLT_GATHER_FORCE`` env var overrides ``force``.
+    exists) and runs the winning (tile_rows, ring_depth) point.  The
+    ``GLT_GATHER_FORCE`` env var overrides ``force``.
     """
     env = os.environ.get("GLT_GATHER_FORCE")
     if env in ("pallas", "xla"):
         force = env
     if force == "pallas":
+        params = _AUTO.get(_auto_key(table, idx))
+        if params is not None:
+            return gather_rows_pallas(table, idx, tile_rows=params[0],
+                                      ring_depth=params[1])
         return gather_rows_pallas(table, idx)
-    if force == "auto" and _AUTO.get(_auto_key(table, idx)) == "pallas":
-        return gather_rows_pallas(table, idx)
+    if force == "auto":
+        params = _AUTO.get(_auto_key(table, idx))
+        if params is not None:
+            return gather_rows_pallas(table, idx, tile_rows=params[0],
+                                      ring_depth=params[1])
     return _xla_gather(table, idx)

@@ -779,6 +779,128 @@ class TestUnboundedQueuePut:
 
 
 # ---------------------------------------------------------------------------
+# GLT013 dispatch-in-epoch-loop
+# ---------------------------------------------------------------------------
+
+class TestDispatchInEpochLoop:
+    def test_positive_device_get_in_loop(self):
+        src = """
+        import jax
+
+        def run_scanned_epoch(step, state, blocks):
+            losses = []
+            for blk in blocks:
+                state, loss = step(state, blk)
+                losses.append(float(jax.device_get(loss)))
+            return state, losses
+        """
+        fs = findings_for(src, "dispatch-in-epoch-loop")
+        assert len(fs) == 2          # device_get + float coercion
+        assert any("every batch" in f.message for f in fs)
+
+    def test_positive_asarray_and_item(self):
+        src = """
+        import numpy as np
+
+        def _run_epoch(step, state, batches):
+            out = []
+            for b in batches:
+                state, loss = step(state, b)
+                out.append(np.asarray(loss))
+                print(loss.item())
+            return out
+        """
+        fs = findings_for(src, "dispatch-in-epoch-loop")
+        assert len(fs) == 2
+        assert any(".item()" in f.message for f in fs)
+
+    def test_positive_block_until_ready_in_while(self):
+        src = """
+        import jax
+
+        def run_pipelined_epoch(step, state, it):
+            while True:
+                b = next(it, None)
+                if b is None:
+                    break
+                state, loss = step(state, b)
+                jax.block_until_ready(loss)
+            return state
+        """
+        fs = findings_for(src, "dispatch-in-epoch-loop")
+        assert len(fs) == 1
+
+    def test_negative_fetch_after_loop(self):
+        src = """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def run_scanned_epoch(step, state, blocks):
+            losses = []
+            for blk in blocks:
+                state, loss = step(state, blk)
+                losses.append(loss)
+            # ONE concat + ONE host fetch at the epoch boundary: the
+            # contract the rule enforces.
+            return state, np.asarray(jax.device_get(
+                jnp.concatenate(losses)))
+        """
+        assert findings_for(src, "dispatch-in-epoch-loop") == []
+
+    def test_negative_non_epoch_function(self):
+        src = """
+        import numpy as np
+
+        def collect_all(step, state, batches):
+            out = []
+            for b in batches:
+                state, loss = step(state, b)
+                out.append(np.asarray(loss))
+            return out
+        """
+        assert findings_for(src, "dispatch-in-epoch-loop") == []
+
+    def test_transitive_helper_sync(self):
+        fs = project_findings({
+            "pkg.stats": """
+                import numpy as np
+
+                def publish_stats(loss):
+                    return float(np.asarray(loss))
+            """,
+            "pkg.driver": """
+                from pkg.stats import publish_stats
+
+                def run_scanned_epoch(step, state, blocks):
+                    for blk in blocks:
+                        state, loss = step(state, blk)
+                        publish_stats(loss)
+                    return state
+            """,
+        }, "dispatch-in-epoch-loop")
+        assert len(fs) == 1
+        assert "publish_stats" in fs[0].message
+        assert "hidden per-batch round trip" in fs[0].message
+
+    def test_suppression(self):
+        src = """
+        import jax
+
+        def run_scanned_epoch(step, state, blocks, on_block=None):
+            for i, blk in enumerate(blocks):
+                state, loss = step(state, blk)
+                if on_block is not None:
+                    # checkpoint hook: the sync is the contract
+                    # gltlint: disable-next=dispatch-in-epoch-loop
+                    jax.block_until_ready(state)
+                    on_block(state, i)
+            return state
+        """
+        assert findings_for(src, "dispatch-in-epoch-loop") == []
+
+
+# ---------------------------------------------------------------------------
 # the project engine: symbols, call graph, effects
 # ---------------------------------------------------------------------------
 
@@ -1389,7 +1511,7 @@ def test_rule_registry_complete():
         "shadowed-jit-donation", "unbounded-blocking-get",
         "lock-order-inversion", "blocking-call-while-holding-lock",
         "span-in-traced-code", "non-atomic-state-publish",
-        "unbounded-queue-put",
+        "unbounded-queue-put", "dispatch-in-epoch-loop",
     }
 
 

@@ -609,57 +609,6 @@ def test_trainloop_supervised_exit_checkpoints_and_raises(tmp_path):
     revived.run()
 
 
-@pytest.mark.slow
-def test_pipelined_epoch_start_batch_replays_identical_suffix(tmp_path):
-    """The overlapped (sample k+1 || train k) driver carries the same
-    resume seam: checkpoint after batch k, restart from a fresh state
-    template at start_batch=k+1, suffix losses bit-equal.  Slow: the
-    fused pipelined program is its own (expensive) compile."""
-    from glt_tpu.models import make_pipelined_train_step
-    from glt_tpu.models.train import run_pipelined_epoch
-
-    ds, labels = _cluster_dataset()
-    model = GraphSAGE(hidden_features=16, out_features=3, num_layers=2,
-                      dropout_rate=0.0)
-    tx = optax.adam(1e-2)
-    bs = 16
-    sampler = NeighborSampler(ds.get_graph(), [4, 4], batch_size=bs,
-                              with_edge=False)
-    feat = ds.get_node_feature()
-    x0 = jnp.zeros((sampler.node_capacity, feat.shape[1]), jnp.float32)
-    ei0 = jnp.full((2, sampler.edge_capacity), -1, jnp.int32)
-    m0 = jnp.zeros((sampler.edge_capacity,), bool)
-    params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
-
-    def fresh():
-        return TrainState(params=params, opt_state=tx.init(params),
-                          step=jnp.zeros((), jnp.int32))
-
-    batches = [np.arange(i * bs, (i + 1) * bs).astype(np.int32)
-               for i in range(4)]
-    base = jax.random.PRNGKey(42)
-    step, sample_first = make_pipelined_train_step(
-        model, tx, sampler, feat, labels, bs)
-
-    ck = Checkpointer(str(tmp_path))
-
-    def save_at(state, i):
-        if i == 1:
-            ck.save(i + 1, {"train_state": capture_pytree(state)})
-
-    full_state, full_losses, _ = run_pipelined_epoch(
-        step, sample_first, batches, fresh(), base, on_step=save_at)
-    full_losses = [float(x) for x in full_losses]
-
-    snap = Checkpointer(str(tmp_path)).resume()
-    revived = restore_pytree(snap.components["train_state"], like=fresh())
-    part_state, part_losses, _ = run_pipelined_epoch(
-        step, sample_first, batches, revived, base,
-        start_batch=snap.step)
-    assert [float(x) for x in part_losses] == full_losses[snap.step:]
-    assert _params_equal(part_state, full_state)
-
-
 # ---------------------------------------------------------------------------
 # dist_train epoch driver: resume seam parity
 # ---------------------------------------------------------------------------

@@ -26,7 +26,11 @@ from glt_tpu.data.feature_cache import (
     cache_stats,
 )
 from glt_tpu.ops.dedup_gather import dedup_counts, dedup_gather_rows
-from glt_tpu.ops.gather_pallas import gather_rows_pallas
+from glt_tpu.ops.gather_pallas import (
+    candidate_gather_params,
+    default_gather_params,
+    gather_rows_pallas,
+)
 
 
 def _naive(table, ids, id2index=None):
@@ -60,13 +64,133 @@ class TestTiledPallasKernel:
         np.testing.assert_allclose(out, np.asarray(table)[np.asarray(idx)])
 
     def test_shape_constraints(self):
-        table = jnp.zeros((16, 100), jnp.float32)  # d % 128 != 0
+        table = jnp.zeros((16, 100), jnp.float32)  # d % 128 != 0, != 64
         with pytest.raises(ValueError, match="multiple of 128"):
             gather_rows_pallas(table, jnp.zeros((8,), jnp.int32),
                                interpret=True)
         with pytest.raises(ValueError, match=">= 8"):
             gather_rows_pallas(jnp.zeros((4, 128), jnp.float32),
                                jnp.zeros((8,), jnp.int32), interpret=True)
+        # Explicit tile past the table raises (the autotuner prunes
+        # these candidates instead of silently shrinking them).
+        with pytest.raises(ValueError, match=">= 32"):
+            gather_rows_pallas(jnp.zeros((16, 128), jnp.float32),
+                               jnp.zeros((8,), jnp.int32), interpret=True,
+                               tile_rows=32, ring_depth=4)
+
+    @pytest.mark.parametrize("tile,ring", candidate_gather_params(128))
+    @pytest.mark.parametrize("b,n", [(256, 300),     # aligned batch
+                                     (1000, 777),    # ragged tail rows
+                                     (37, 64)])      # sub-chunk batch
+    def test_sweep_candidates_exact(self, tile, ring, b, n):
+        """Every (tile_rows, ring_depth) point the autotuner can select
+        must be bit-exact on ragged tails and random id patterns —
+        autotune may pick ANY of these, so all of them are contract."""
+        if n < tile:
+            pytest.skip("table shorter than tile (autotune prunes)")
+        rng = np.random.default_rng(tile * 1000 + ring * 100 + b)
+        table = jnp.asarray(rng.normal(size=(n, 128)).astype(np.float32))
+        idx = jnp.asarray(rng.integers(-2, n, b).astype(np.int32))
+        out = np.asarray(gather_rows_pallas(table, idx, interpret=True,
+                                            tile_rows=tile,
+                                            ring_depth=ring))
+        assert (out == np.asarray(table)[
+            np.clip(np.asarray(idx), 0, n - 1)]).all()
+
+    @pytest.mark.parametrize("tile,ring", [(8, 4), (32, 8)])
+    def test_all_duplicate_ids(self, tile, ring):
+        """An all-duplicate batch (one hub id repeated) collapses to a
+        single DMA per chunk — the degenerate coalescing case."""
+        rng = np.random.default_rng(5)
+        table = jnp.asarray(rng.normal(size=(64, 128)).astype(np.float32))
+        idx = jnp.full((513,), 7, jnp.int32)
+        out = np.asarray(gather_rows_pallas(table, idx, interpret=True,
+                                            tile_rows=tile,
+                                            ring_depth=ring))
+        assert (out == np.asarray(table)[7]).all()
+
+    @pytest.mark.parametrize("d", [64, 256])
+    def test_width_specialized_variants(self, d):
+        """d=256 runs natively; d=64 runs through the paired-row view
+        ([N/2, 128] tiles + epilogue half-select) — both bit-exact."""
+        rng = np.random.default_rng(d)
+        n, b = 200, 143
+        table = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        idx = jnp.asarray(rng.integers(-1, n, b).astype(np.int32))
+        out = np.asarray(gather_rows_pallas(table, idx, interpret=True,
+                                            tile_rows=8, ring_depth=4))
+        assert (out == np.asarray(table)[
+            np.clip(np.asarray(idx), 0, n - 1)]).all()
+
+    def test_d64_needs_even_rows(self):
+        with pytest.raises(ValueError, match="even"):
+            gather_rows_pallas(jnp.zeros((33, 64), jnp.float32),
+                               jnp.zeros((8,), jnp.int32), interpret=True)
+
+    def test_width_specialized_defaults(self):
+        """Defaults hold DMA byte depth roughly constant across widths
+        (~16KB) and respect dtype sublane minimums."""
+        t64, _ = default_gather_params(64)
+        t128, _ = default_gather_params(128)
+        t256, _ = default_gather_params(256)
+        assert t64 >= t128 >= t256 >= 8
+        tb16, _ = default_gather_params(128, jnp.bfloat16)
+        assert tb16 >= 16          # bf16 sublane minimum
+        assert all(t >= 16 for t, _ in
+                   candidate_gather_params(128, jnp.bfloat16))
+
+
+class TestAutotuneTable:
+    def test_keyed_by_exact_shape(self):
+        """The decision table keys include the exact batch size: an
+        occupancy-capped gather shape gets its OWN entry instead of
+        inheriting the full-cap winner (the BENCH_r05 gather_ms_capped
+        inversion this round fixes).  Off-TPU both pin 'xla' with an
+        empty sweep."""
+        from glt_tpu.ops import gather_pallas as gp
+
+        gp.reset_autotune()
+        try:
+            table = jnp.zeros((64, 128), jnp.float32)
+            full = jnp.zeros((512,), jnp.int32)
+            capped = jnp.zeros((256,), jnp.int32)
+            assert gp.autotune_gather_rows(table, full) == "xla"
+            assert gp.autotune_gather_rows(table, capped) == "xla"
+            tab = gp.autotune_table()
+            assert "d128_b512_float32" in tab
+            assert "d128_b256_float32" in tab
+            assert tab["d128_b512_float32"]["winner"] == "xla"
+        finally:
+            gp.reset_autotune()
+
+    def test_gather_rows_follows_winner_params(self, monkeypatch):
+        """gather_rows(force='auto') must dispatch the memoized
+        (tile_rows, ring_depth) point for its exact shape."""
+        from glt_tpu.ops import gather_pallas as gp
+
+        calls = {}
+
+        def fake_pallas(table, idx, tile_rows=None, ring_depth=None):
+            calls["params"] = (tile_rows, ring_depth)
+            return jnp.take(table, jnp.clip(idx, 0, table.shape[0] - 1),
+                            axis=0)
+
+        monkeypatch.setattr(gp, "gather_rows_pallas", fake_pallas)
+        gp.reset_autotune()
+        try:
+            table = jnp.zeros((64, 128), jnp.float32)
+            idx = jnp.zeros((256,), jnp.int32)
+            gp._AUTO[gp._auto_key(table, idx)] = (16, 4)
+            gp.gather_rows(table, idx, force="auto")
+            assert calls["params"] == (16, 4)
+            # A DIFFERENT batch size has no entry -> XLA fallback, the
+            # fake kernel must not be touched.
+            calls.clear()
+            gp.gather_rows(table, jnp.zeros((128,), jnp.int32),
+                           force="auto")
+            assert calls == {}
+        finally:
+            gp.reset_autotune()
 
 
 class TestDedupGather:
@@ -231,51 +355,6 @@ class TestTrainStepIntegration:
         assert cached == base
         stats = cache_stats(step.feature_cache())
         assert stats["lookups"] > 0 and stats["misses"] > 0
-
-    def test_pipelined_step_cache_matches_baseline(self):
-        from glt_tpu.models import (
-            GraphSAGE,
-            TrainState,
-            make_pipelined_train_step,
-            run_pipelined_epoch,
-        )
-        from glt_tpu.sampler import NeighborSampler
-
-        ds, labels = _tiny_dataset()
-        model = GraphSAGE(hidden_features=8, out_features=3, num_layers=2,
-                          dropout_rate=0.0)
-        tx = optax.adam(1e-2)
-        bs = 8
-        sampler = NeighborSampler(ds.get_graph(), [3, 3], batch_size=bs,
-                                  with_edge=False)
-        feat = ds.get_node_feature()
-        x0 = jnp.zeros((sampler.node_capacity, feat.shape[1]), jnp.float32)
-        ei0 = jnp.full((2, sampler.edge_capacity), -1, jnp.int32)
-        m0 = jnp.zeros((sampler.edge_capacity,), bool)
-        params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
-
-        def fresh():
-            return TrainState(params=params, opt_state=tx.init(params),
-                              step=jnp.zeros((), jnp.int32))
-
-        batches = [np.arange(i * bs, (i + 1) * bs).astype(np.int32)
-                   for i in range(3)]
-        key = jax.random.PRNGKey(11)
-
-        step, first = make_pipelined_train_step(model, tx, sampler, feat,
-                                                labels, bs)
-        _, base, _ = run_pipelined_epoch(step, first, batches, fresh(), key)
-        base = [float(l) for l in base]
-
-        cache = cache_init(feat.size, 32, feat.shape[1], jnp.float32)
-        step_c, first_c = make_pipelined_train_step(
-            model, tx, sampler, feat, labels, bs, dedup=True,
-            feature_cache=cache)
-        _, got, _ = run_pipelined_epoch(step_c, first_c, batches, fresh(),
-                                        key)
-        assert [float(l) for l in got] == base
-        stats = cache_stats(step_c.feature_cache())
-        assert stats["lookups"] > 0
 
     def test_cache_dtype_mismatch_rejected(self):
         from glt_tpu.models import GraphSAGE, make_scanned_node_train_step

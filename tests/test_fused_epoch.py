@@ -1,0 +1,291 @@
+"""Fused-epoch equivalence: the scanned scan-group programs (homo and
+distributed) must be BIT-identical to their unfused serial references.
+
+The overlapped epoch driver was deleted in the gather-wall round (three
+bench rounds at 0.97-0.99x; see glt_tpu/models/train.py); the scanned
+route is now the ONLY compiled epoch driver, so these tests are the
+guarantee that fusing sample->dedup->gather->train into one program per
+scan group changes NOTHING about the trained values — losses,
+accuracies, params, and feature-cache counters compare with `==` on the
+raw bits, homo and dist alike.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import Mesh
+
+from glt_tpu.data import Dataset
+from glt_tpu.data.topology import CSRTopo
+from glt_tpu.models import GraphSAGE
+
+N_DEV = 8
+
+
+def _params_bits_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        if not (np.asarray(x) == np.asarray(y)).all():
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# homo: scanned fused epoch vs serial stream, with feature cache
+# ---------------------------------------------------------------------------
+
+def _cluster_dataset(n=48, dim=8, classes=3, rng_seed=0):
+    rng = np.random.default_rng(rng_seed)
+    labels = np.arange(n) % classes
+    src, dst = [], []
+    for c in range(classes):
+        members = np.where(labels == c)[0]
+        for i in members:
+            for j in rng.choice(members, size=3, replace=False):
+                src.append(i)
+                dst.append(j)
+    feat = np.eye(classes, dtype=np.float32)[labels]
+    feat = np.concatenate(
+        [feat, rng.normal(0, 0.1, (n, dim - classes)).astype(np.float32)],
+        1)
+    return (Dataset()
+            .init_graph(np.stack([np.array(src), np.array(dst)]),
+                        graph_mode="HOST", num_nodes=n)
+            .init_node_features(feat)
+            .init_node_labels(labels)), labels
+
+
+def test_fused_epoch_cache_stats_match_serial_stream():
+    """Threading the HBM feature cache through the fused scan-group
+    program must leave losses AND cache counters bit-identical to the
+    unfused per-batch dispatch stream (same program, one real slot per
+    dispatch — padded slots are exact no-ops and probe the cache with
+    all-padding id lists, which hit nothing and count nothing)."""
+    from glt_tpu.data.feature_cache import cache_init, publish_cache_stats
+    from glt_tpu.models import TrainState, make_scanned_node_train_step
+    from glt_tpu.sampler import NeighborSampler
+
+    ds, labels = _cluster_dataset()
+    model = GraphSAGE(hidden_features=8, out_features=3, num_layers=2,
+                      dropout_rate=0.0)
+    tx = optax.adam(1e-2)
+    bs, G = 8, 4
+    sampler = NeighborSampler(ds.get_graph(), [3, 3], batch_size=bs,
+                              with_edge=False)
+    feat = ds.get_node_feature()
+    x0 = jnp.zeros((sampler.node_capacity, feat.shape[1]), jnp.float32)
+    ei0 = jnp.full((2, sampler.edge_capacity), -1, jnp.int32)
+    m0 = jnp.zeros((sampler.edge_capacity,), bool)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
+
+    def fresh():
+        return TrainState(params=params, opt_state=tx.init(params),
+                          step=jnp.zeros((), jnp.int32))
+
+    block = np.arange(G * bs).reshape(G, bs).astype(np.int32)
+    base = jax.random.PRNGKey(21)
+
+    def run(stream: bool):
+        step = make_scanned_node_train_step(
+            model, tx, sampler, feat, labels, bs,
+            feature_cache=cache_init(feat.size, 32, feat.shape[1],
+                                     jnp.float32))
+        st = fresh()
+        losses = []
+        if stream:
+            for i in range(G):
+                lone = np.full((G, bs), -1, np.int32)
+                lone[i] = block[i]
+                st, ls, _, _ = step(st, lone, base)
+                losses.append(float(ls[i]))
+        else:
+            st, ls, _, _ = step(st, block, base)
+            losses = [float(x) for x in ls]
+        stats = publish_cache_stats(step.feature_cache())
+        return st, losses, stats
+
+    st_f, losses_f, stats_f = run(stream=False)
+    st_s, losses_s, stats_s = run(stream=True)
+    assert losses_f == losses_s
+    assert _params_bits_equal(st_f.params, st_s.params)
+    # Counter parity: the padded no-op slots of the stream probe the
+    # cache with -1 lists only, so hits/misses/resident must agree.
+    for k in ("hits", "misses", "lookups", "resident"):
+        assert stats_f[k] == stats_s[k], (k, stats_f, stats_s)
+    assert stats_f["lookups"] > 0
+
+
+# ---------------------------------------------------------------------------
+# dist: scanned fused dist step vs the serial dist step
+# ---------------------------------------------------------------------------
+
+def _dist_setup(bs=4, fanouts=(3, 3)):
+    devs = jax.devices()[:N_DEV]
+    mesh = Mesh(np.array(devs), ("shard",))
+    n, classes = 64, 4
+    rng = np.random.default_rng(0)
+    labels = (np.arange(n) % classes).astype(np.int32)
+    src, dst = [], []
+    for c in range(classes):
+        members = np.where(labels == c)[0]
+        for i in members:
+            for j in rng.choice(members, 3, replace=False):
+                src.append(i)
+                dst.append(j)
+    topo = CSRTopo(np.stack([np.array(src), np.array(dst)]), num_nodes=n)
+    feat = np.eye(classes, dtype=np.float32)[labels]
+    feat = np.concatenate(
+        [feat, rng.normal(0, .1, (n, 4)).astype(np.float32)], 1)
+
+    from glt_tpu.parallel import shard_feature, shard_graph
+
+    g = shard_graph(topo, N_DEV)
+    f = shard_feature(feat, N_DEV)
+    lab = jnp.asarray(labels.reshape(N_DEV, g.nodes_per_shard))
+    model = GraphSAGE(hidden_features=16, out_features=classes,
+                      num_layers=2, dropout_rate=0.0)
+    tx = optax.adam(1e-2)
+    return mesh, g, f, lab, model, tx, list(fanouts), bs
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dedup", [False, True])
+def test_scanned_dist_step_matches_serial_bits(dedup):
+    """The fused dist scan group == the serial dist step driven batch by
+    batch under the scan's key schedule: losses, accs, and final params
+    bit-equal (the dist half of the fused-epoch guarantee).  Slow: it
+    compiles the scanned program, the serial program, and drives the
+    unfused dispatch stream — CI runs it in the microbench-smoke job's
+    unfiltered fused-epoch step."""
+    from glt_tpu.parallel import (
+        init_dist_state,
+        make_dist_train_step,
+        make_scanned_dist_train_step,
+    )
+
+    mesh, g, f, lab, model, tx, fanouts, bs = _dist_setup()
+    G = 3
+    rng = np.random.default_rng(1)
+    blk = np.stack([
+        np.stack([rng.choice(np.arange(s * 8, (s + 1) * 8), bs,
+                             replace=False)
+                  for s in range(N_DEV)])
+        for _ in range(G)]).astype(np.int32)
+    base = jax.random.PRNGKey(17)
+
+    state0 = init_dist_state(model, tx, g, f, jax.random.PRNGKey(0),
+                             fanouts, bs)
+    sstep = make_scanned_dist_train_step(model, tx, g, f, lab, mesh,
+                                         fanouts, bs, dedup_gather=dedup)
+    st_f, losses_f, accs_f = sstep(state0, blk, base)
+
+    step = make_dist_train_step(model, tx, g, f, lab, mesh, fanouts, bs,
+                                dedup_gather=dedup)
+    st_s = init_dist_state(model, tx, g, f, jax.random.PRNGKey(0),
+                           fanouts, bs)
+    keys = jax.random.split(base, G)
+    losses_s, accs_s = [], []
+    for i in range(G):
+        st_s, loss, acc = step(st_s, jnp.asarray(blk[i]), keys[i])
+        losses_s.append(float(loss))
+        accs_s.append(float(acc))
+
+    # Per-batch losses/accs are EXACT vs the serial step (the sampled
+    # subgraphs, gathers, and forward/backward are the same values);
+    # final params agree to float32 round-off — the optimizer update
+    # compiles inside the scan body vs outside shard_map, and XLA's
+    # fusion of adam's rsqrt chain differs by ULPs between the two
+    # placements.
+    assert [float(x) for x in losses_f] == losses_s
+    assert [float(x) for x in accs_f] == accs_s
+    assert int(st_f.step) == G
+    for a, b in zip(jax.tree_util.tree_leaves(st_f.params),
+                    jax.tree_util.tree_leaves(st_s.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+    # BIT-identity holds against the unfused dispatch stream of the
+    # same program (one real slot per dispatch, padded siblings are
+    # no-ops) — same guarantee as the homo fused-epoch test.
+    st_u = init_dist_state(model, tx, g, f, jax.random.PRNGKey(0),
+                           fanouts, bs)
+    losses_u = []
+    for i in range(G):
+        lone = np.full((G, N_DEV, bs), -1, np.int32)
+        lone[i] = blk[i]
+        st_u, ls, _ = sstep(st_u, lone, base)
+        losses_u.append(float(ls[i]))
+    assert [float(x) for x in losses_f] == losses_u
+    assert _params_bits_equal(st_f.params, st_u.params)
+
+
+def test_scanned_dist_padded_slot_is_noop():
+    """A fully padded scan slot (every shard all -1) must not move
+    params or the step counter — the trailing-block contract of
+    dist_seed_blocks."""
+    from glt_tpu.parallel import (
+        init_dist_state,
+        make_scanned_dist_train_step,
+    )
+
+    mesh, g, f, lab, model, tx, fanouts, bs = _dist_setup()
+    rng = np.random.default_rng(2)
+    real = np.stack([rng.choice(np.arange(s * 8, (s + 1) * 8), bs,
+                                replace=False)
+                     for s in range(N_DEV)]).astype(np.int32)
+    blk = np.stack([real, np.full((N_DEV, bs), -1, np.int32)])
+    base = jax.random.PRNGKey(3)
+
+    state0 = init_dist_state(model, tx, g, f, jax.random.PRNGKey(0),
+                             fanouts, bs)
+    sstep = make_scanned_dist_train_step(model, tx, g, f, lab, mesh,
+                                         fanouts, bs)
+    st, losses, accs = sstep(state0, blk, base)
+    assert int(st.step) == 1          # only the real slot stepped
+
+    # Params equal the SERIAL step run over the real batch alone under
+    # the scan's slot-0 key.
+    from glt_tpu.parallel import make_dist_train_step
+
+    step = make_dist_train_step(model, tx, g, f, lab, mesh, fanouts, bs)
+    st2, _, _ = step(init_dist_state(model, tx, g, f,
+                                     jax.random.PRNGKey(0), fanouts, bs),
+                     jnp.asarray(real), jax.random.split(base, 2)[0])
+    assert _params_bits_equal(st.params, st2.params)
+
+
+def test_run_scanned_dist_epoch_driver():
+    """The dist epoch driver shuffles into [G, S, B] blocks, trims
+    padded trailing slots, and matches a manual block loop exactly."""
+    from glt_tpu.parallel import (
+        dist_seed_blocks,
+        init_dist_state,
+        make_scanned_dist_train_step,
+        run_scanned_dist_epoch,
+    )
+
+    mesh, g, f, lab, model, tx, fanouts, bs = _dist_setup()
+    G = 2
+    train_idx = np.arange(40)          # 40 seeds / (4*8) = 1.25 batches
+    base = jax.random.PRNGKey(5)
+    state0 = init_dist_state(model, tx, g, f, jax.random.PRNGKey(0),
+                             fanouts, bs)
+    sstep = make_scanned_dist_train_step(model, tx, g, f, lab, mesh,
+                                         fanouts, bs)
+
+    st, losses, accs = run_scanned_dist_epoch(
+        sstep, state0, train_idx, N_DEV, bs, G,
+        np.random.default_rng(7), base)
+    assert losses.shape == (2,) and accs.shape == (2,)
+    assert int(st.step) == 2
+
+    st2 = init_dist_state(model, tx, g, f, jax.random.PRNGKey(0),
+                          fanouts, bs)
+    m_losses = []
+    for i, blk in enumerate(dist_seed_blocks(
+            train_idx, N_DEV, bs, G, np.random.default_rng(7))):
+        st2, ls, _ = sstep(st2, blk, jax.random.fold_in(base, i))
+        m_losses += [float(x) for x in np.asarray(ls)]
+    assert [float(x) for x in losses] == m_losses[:2]
+    assert _params_bits_equal(st.params, st2.params)

@@ -26,11 +26,10 @@ def test_digits_knn_pipeline_accuracy():
         GraphSAGE,
         TrainState,
         make_eval_step,
-        make_pipelined_train_step,
-        run_pipelined_epoch,
+        make_scanned_node_train_step,
+        run_scanned_epoch,
     )
     from glt_tpu.sampler import NeighborSampler
-    from examples.train_sage_products import seed_batches
 
     exds.DATA_ROOT = os.path.join(REPO, "data")
     ds, train_idx = exds._from_disk("digits-knn", graph_mode="HOST")
@@ -55,13 +54,15 @@ def test_digits_knn_pipeline_accuracy():
     params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
     state = TrainState(params=params, opt_state=tx.init(params),
                        step=jax.numpy.zeros((), jax.numpy.int32))
-    step, sample_first = make_pipelined_train_step(
-        model, tx, sampler, feat, labels, bs)
+    # The fused scanned epoch — the only compiled epoch driver after the
+    # overlapped path's deletion (see glt_tpu/models/train.py).
+    step = make_scanned_node_train_step(model, tx, sampler, feat, labels,
+                                        bs)
     rng = np.random.default_rng(0)
     for epoch in range(12):
-        state, losses, accs = run_pipelined_epoch(
-            step, sample_first, seed_batches(train_idx, bs, rng),
-            state, jax.random.PRNGKey(100 + epoch))
+        state, losses, accs, _ = run_scanned_epoch(
+            step, state, train_idx, bs, 2, rng,
+            jax.random.PRNGKey(100 + epoch))
 
     ev = make_eval_step(model, batch_size=bs)
     loader = NeighborLoader(ds, fanout, test_idx, batch_size=bs,

@@ -107,13 +107,15 @@ class TestCompare:
         assert row["status"] == "info"
 
     def test_stuck_requires_flat_and_unmet_target(self):
-        flat_unmet = [("r1", {"overlap_speedup": 0.97}),
-                      ("r2", {"overlap_speedup": 0.99}),
-                      ("fresh", {"overlap_speedup": 0.98})]
-        assert compare(flat_unmet)["stuck"] == ["overlap_speedup"]
-        met = [("r1", {"overlap_speedup": 1.20}),
-               ("r2", {"overlap_speedup": 1.21}),
-               ("fresh", {"overlap_speedup": 1.20})]
+        # best_step_ms carries the headline aspiration (<= 40 ms) the
+        # retired overlap_speedup target used to exercise here.
+        flat_unmet = [("r1", {"best_step_ms": 51.9}),
+                      ("r2", {"best_step_ms": 52.3}),
+                      ("fresh", {"best_step_ms": 51.7})]
+        assert compare(flat_unmet)["stuck"] == ["best_step_ms"]
+        met = [("r1", {"best_step_ms": 38.0}),
+               ("r2", {"best_step_ms": 38.4}),
+               ("fresh", {"best_step_ms": 37.9})]
         assert compare(met)["stuck"] == []
 
     def test_new_and_gone_metrics(self):
@@ -150,20 +152,26 @@ class TestCommittedHistory:
         assert len(runs) >= 5
         assert all("value" in m for _, m in runs)
 
-    def test_overlap_speedup_flagged_stuck(self):
+    def test_overlap_speedup_retired_shows_gone(self):
+        """The overlapped path was deleted (ISSUE 10c): a fresh run no
+        longer emits overlap_speedup / overlapped_step_ms*, and the
+        trend table must report those rows as ``gone`` — the retirement
+        is visible, not silent — without flagging them stuck."""
         runs = self._history()
-        # A fresh run that repeats the r05 numbers — exactly the
-        # "nothing moved again" state the harness must surface.
-        runs.append(("fresh", dict(runs[-1][1])))
+        fresh = {k: v for k, v in runs[-1][1].items()
+                 if not k.startswith("overlap")}
+        runs.append(("fresh", fresh))
         rep = compare(runs)
-        assert "overlap_speedup" in rep["stuck"]
+        by = {r["metric"]: r["status"] for r in rep["rows"]}
+        assert by["overlap_speedup"] == "gone"
+        assert by["overlapped_step_ms"] == "gone"
+        assert "overlap_speedup" not in rep["stuck"]
 
     def test_markdown_trend_table(self):
         runs = self._history()
         runs.append(("fresh", dict(runs[-1][1])))
         md = markdown_report(compare(runs))
         assert "| `overlap_speedup` |" in md
-        assert "stuck" in md
         assert "Verdict" in md
         # one column per run + metric + delta + status
         header = [ln for ln in md.splitlines()
