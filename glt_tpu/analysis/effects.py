@@ -83,6 +83,19 @@ BLOCKING_METHODS = {
     "sendall": "send", "accept": "accept", "connect": "connect",
     "communicate": "subprocess",
 }
+# Synchronous disk-read entry points (GLT014): dotted calls that hit
+# storage on the calling thread.  MMAP_CALLS additionally taint the
+# assigned name — slicing a memmap is a page-fault disk read even
+# though no call appears at the slice site.
+DISK_CALLS = {
+    "numpy.load": "np.load", "numpy.fromfile": "np.fromfile",
+    "numpy.loadtxt": "np.loadtxt", "numpy.memmap": "np.memmap",
+    "mmap.mmap": "mmap.mmap",
+}
+MMAP_CALLS = {"numpy.memmap", "mmap.mmap"}
+# File-object read method spellings (receiver-agnostic, like
+# BLOCKING_METHODS): .read()/.readinto()/.readline(s)().
+DISK_READ_METHODS = {"read", "readinto", "readline", "readlines"}
 # Zero-argument spellings of the GLT007 hang class.
 WAIT_METHODS = {"get": "get", "join": "join", "wait": "wait"}
 # Kinds exempted in a scope that runs the timeout-and-recheck pattern.
@@ -124,6 +137,7 @@ class PairSite:
 class Summary:
     """Composable, context-free effect summary of one function."""
     blocking: Tuple[BlockSite, ...] = ()
+    disk: Tuple[BlockSite, ...] = ()
     acquires: FrozenSet[str] = frozenset()
     sync_params: Tuple[Tuple[str, SyncSite], ...] = ()
     key_params: FrozenSet[str] = frozenset()
@@ -153,6 +167,7 @@ class ScopeFacts:
     scope: FunctionScope
     blocks: List[Tuple[BlockSite, Tuple[str, ...]]] = field(
         default_factory=list)
+    disk: List[BlockSite] = field(default_factory=list)
     calls: List[CallFact] = field(default_factory=list)
     acquisitions: List[Tuple[str, int]] = field(default_factory=list)
     pairs: List[Tuple[str, str, int]] = field(default_factory=list)
@@ -237,6 +252,7 @@ class EffectEngine:
         facts.type_env = self._build_type_env(module, scope)
         self._walk_body(facts, scope.node.body, (), frozenset(), 0)
         self._sync_and_key_facts(facts)
+        self._disk_facts(facts)
         if facts.liveness:
             # GLT007 exemption: a liveness-rechecking scope's poll waits
             # are bounded by the recheck loop, not hang sources.
@@ -374,6 +390,12 @@ class EffectEngine:
             facts.liveness = True
         if name in COLLECTIVES:
             facts.collective = True
+        if name in DISK_CALLS:
+            facts.disk.append(
+                BlockSite("disk", call.lineno, f"{DISK_CALLS[name]}()", 0))
+        elif attr in DISK_READ_METHODS:
+            facts.disk.append(
+                BlockSite("disk", call.lineno, f".{attr}()", 0))
         kind = None
         detail = None
         if name in BLOCKING_CALLS:
@@ -401,6 +423,29 @@ class EffectEngine:
         if callee is not None:
             facts.calls.append(
                 CallFact(call, callee, call.lineno, held))
+
+    # -- disk-read facts (GLT014) -------------------------------------------
+    def _disk_facts(self, facts: ScopeFacts) -> None:
+        """Taint names assigned from mmap constructors and record their
+        subscript loads as disk sites: slicing a memmap page-faults to
+        storage with no call expression at the read site."""
+        module, scope = facts.module, facts.scope
+        mapped: Set[str] = set()
+        for node in walk_own(scope.node):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = getattr(node, "value", None)
+                if (isinstance(value, ast.Call)
+                        and module.call_name(value) in MMAP_CALLS):
+                    mapped.update(assign_targets(node))
+        if not mapped:
+            return
+        for node in walk_own(scope.node):
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in mapped):
+                facts.disk.append(BlockSite(
+                    "disk", node.lineno,
+                    f"{node.value.id}[...] (mmap page fault)", 0))
 
     # -- intraprocedural dataflow: host-sync params + key params ------------
     def _sync_and_key_facts(self, facts: ScopeFacts) -> None:
@@ -467,6 +512,7 @@ class EffectEngine:
     def _compute(self, fid: str) -> bool:
         facts = self.facts[fid]
         blocking: List[BlockSite] = [b for b, _held in facts.blocks]
+        disk: List[BlockSite] = list(facts.disk)
         acquires: Set[str] = {lid for lid, _line in facts.acquisitions}
         sync_params: Dict[str, SyncSite] = dict(facts.sync_sites)
         key_params: Set[str] = set(facts.key_params)
@@ -489,6 +535,12 @@ class EffectEngine:
                     blocking.append(BlockSite(
                         "call", cf.line,
                         f"{short}() -> {b.detail}", b.depth + 1))
+            if csum.disk:
+                d = csum.disk[0]
+                if d.depth + 1 <= MAX_CHAIN_DEPTH:
+                    disk.append(BlockSite(
+                        "disk", cf.line,
+                        f"{short}() -> {d.detail}", d.depth + 1))
             for outer in cf.held:
                 for inner in csum.acquires:
                     if outer != inner:
@@ -502,8 +554,10 @@ class EffectEngine:
                     facts, cf, csum, short, params, sync_params,
                     key_params)
         blocking.sort(key=lambda b: (b.depth, b.line))
+        disk.sort(key=lambda b: (b.depth, b.line))
         summary = Summary(
             blocking=tuple(blocking[:_MAX_BLOCK_SITES]),
+            disk=tuple(disk[:_MAX_BLOCK_SITES]),
             acquires=frozenset(acquires),
             sync_params=tuple(sorted(sync_params.items())),
             key_params=frozenset(key_params),

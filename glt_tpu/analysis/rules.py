@@ -22,7 +22,14 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
-from .effects import COERCIONS, HOST_SYNC_CALLS, SYNC_METHODS
+from .effects import (
+    COERCIONS,
+    DISK_CALLS,
+    DISK_READ_METHODS,
+    HOST_SYNC_CALLS,
+    MMAP_CALLS,
+    SYNC_METHODS,
+)
 from .effects import KEY_SOURCES as _KEY_SOURCES_IMPORTED
 from .effects import NON_CONSUMING as _NON_CONSUMING_IMPORTED
 from .report import Finding, Severity
@@ -1306,6 +1313,114 @@ class DispatchInEpochLoop(Rule):
                         f"({sym.module.path}:{site.line}) — a hidden "
                         f"per-batch round trip; fetch after the epoch "
                         f"instead")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# GLT014 blocking-io-in-epoch-loop
+# ---------------------------------------------------------------------------
+
+@register
+class BlockingIOInEpochLoop(Rule):
+    """Synchronous disk reads inside an epoch driver's batch loop.
+
+    The disk tier's contract (docs/storage.md): storage I/O belongs on
+    the DRAM stager's background threads, hinted ahead of the sampler —
+    a synchronous read (``np.load``/``np.fromfile``, slicing a
+    ``np.memmap``, a file object's ``.read()``) inside the per-batch
+    loop of a ``run_*epoch*`` driver puts device-idle milliseconds on
+    every batch: the demand-fault path the stage-ahead hook exists to
+    avoid.  Staging threads are out of scope by construction — they are
+    not epoch drivers.
+
+    Direct reads are always flagged; with a project, calls into helpers
+    whose effect summary reaches a disk read (``DiskFeatureStore.
+    gather_into`` -> ``_read_chunk`` -> memmap slice) are flagged one
+    call deep.  Deliberate synchronous reads — the degraded fallback a
+    failed stage leaves behind — carry a justified suppression.
+    """
+    name = "blocking-io-in-epoch-loop"
+    code = "GLT014"
+    severity = Severity.ERROR
+    description = ("synchronous disk read inside an epoch driver's "
+                   "batch loop (device idles behind storage; stage "
+                   "ahead on the DRAM stager's threads instead)")
+
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
+        findings: List[Finding] = []
+        for scope in module.scopes:
+            if not DispatchInEpochLoop._is_epoch_driver(scope.name):
+                continue
+            mapped = self._mmap_names(module, scope)
+            for loop in _walk_own(scope.node):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                for node in ast.walk(loop):
+                    f = self._check_node(module, scope, node, mapped,
+                                         project)
+                    if f is not None:
+                        findings.append(f)
+        return findings
+
+    @staticmethod
+    def _mmap_names(module: ModuleInfo, scope) -> set:
+        """Names assigned from mmap constructors anywhere in the scope
+        (the constructor is usually hoisted above the loop; the reads
+        are the slices inside it)."""
+        mapped = set()
+        for node in _walk_own(scope.node):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = getattr(node, "value", None)
+                if (isinstance(value, ast.Call)
+                        and module.call_name(value) in MMAP_CALLS):
+                    mapped.update(assign_targets(node))
+        return mapped
+
+    def _check_node(self, module: ModuleInfo, scope, node: ast.AST,
+                    mapped: set, project) -> Optional[Finding]:
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in mapped):
+            return self.finding(
+                module, node,
+                f"slicing memmap '{node.value.id}' inside the batch "
+                f"loop of epoch driver '{scope.name}' page-faults to "
+                f"storage per batch — stage the rows ahead "
+                f"(DramStager.stage_ahead) or justify with a "
+                f"suppression")
+        if not isinstance(node, ast.Call):
+            return None
+        name = module.call_name(node)
+        if name in DISK_CALLS:
+            return self.finding(
+                module, node,
+                f"'{name}' inside the batch loop of epoch driver "
+                f"'{scope.name}' reads storage on the dispatch thread "
+                f"every batch — stage ahead on the DRAM stager's "
+                f"threads, or justify with a suppression")
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in DISK_READ_METHODS):
+            return self.finding(
+                module, node,
+                f".{node.func.attr}() inside the batch loop of epoch "
+                f"driver '{scope.name}' is a synchronous file read per "
+                f"batch — move it to a staging thread or justify with "
+                f"a suppression")
+        # One call deep: a helper whose effect summary reaches a disk
+        # read (project-wide pass only).
+        if project is not None:
+            sym = project.resolve_call(module, scope, node)
+            if isinstance(sym, FunctionSymbol):
+                summary = project.effects.summary_for(sym)
+                if summary.disk:
+                    d = summary.disk[0]
+                    return self.finding(
+                        module, node,
+                        f"'{sym.short}' called in the batch loop of "
+                        f"epoch driver '{scope.name}' reaches a disk "
+                        f"read ({d.detail}, {sym.module.path}:{d.line})"
+                        f" — a synchronous storage hit per batch; "
+                        f"stage ahead instead")
         return None
 
 

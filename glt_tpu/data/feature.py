@@ -38,6 +38,7 @@ VALUES are range-checked before the cast — silent truncation raises
 """
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 import jax
@@ -111,17 +112,112 @@ class Feature:
         self._hot = jnp.asarray(feature_array[: self._hot_count], self.dtype)
         # Host tier; kept as a contiguous numpy view for fast np.take.
         self._cold = np.ascontiguousarray(feature_array[self._hot_count:])
+        self._cold_count = self._cold.shape[0]
+        self._cold_np_dtype = self._cold.dtype
         self._id2index = (
             None if id2index is None else jnp.asarray(id2index, jnp.int32))
         self._id2index_np = (
             None if id2index is None else np.asarray(id2index, np.int32))
         self._host_full = feature_array  # for cpu_get / save paths
+        self._store = None               # optional disk tier (glt_tpu.store)
+        self._stager = None
+        self.bytes_from_hbm = 0          # hot-tier bytes served (tiered path)
         self._gather_jit = None          # device-array ids (no donation)
         self._gather_jit_host = None     # host ids: fresh buffer, donated
         self._cache = None               # optional cold-tier HBM cache
         self._cache_lookup_jit = None
         self._merge_cached_jit = None
         self._merge_jit = None
+
+    @classmethod
+    def from_store(cls, store, dram_budget_bytes: int,
+                   split_ratio: float = 0.0,
+                   id2index: Optional[np.ndarray] = None,
+                   dtype=None, dedup: bool = False,
+                   stage_threads: int = 1,
+                   prefetch_scores: Optional[np.ndarray] = None
+                   ) -> "Feature":
+        """Third-tier constructor: features live on disk, never fully in
+        DRAM (docs/storage.md).
+
+        The ``split_ratio`` prefix loads to HBM once (straight from the
+        store); every other row is served by a
+        :class:`~glt_tpu.store.stager.DramStager` under the given
+        (enforced) DRAM budget — cold gathers are bit-identical to the
+        all-DRAM :class:`Feature`, only their residency differs.
+        ``prefetch_scores`` (e.g. :func:`~glt_tpu.partition.
+        frequency_partitioner.residency_scores` over the partition
+        book's access statistics) warms the stager's DRAM set.
+        """
+        from ..store.stager import DramStager
+
+        self = cls.__new__(cls)
+        self._n, self._dim = store.num_rows, store.dim
+        self.split_ratio = float(split_ratio)
+        self._hot_count = int(self._n * self.split_ratio)
+        hot_np = store.read_rows(np.arange(self._hot_count, dtype=np.int64))
+        self.dtype = dtype or jnp.asarray(np.zeros(1, store.dtype)).dtype
+        self.dedup = bool(dedup)
+        self._hot = jnp.asarray(hot_np, self.dtype)
+        self._cold = None                # no DRAM copy of the cold tier
+        self._cold_count = self._n - self._hot_count
+        self._cold_np_dtype = store.dtype
+        self._id2index = (
+            None if id2index is None else jnp.asarray(id2index, jnp.int32))
+        self._id2index_np = (
+            None if id2index is None else np.asarray(id2index, np.int32))
+        self._host_full = None           # cpu_get reads the store directly
+        self._store = store
+        self._stager = DramStager(store, dram_budget_bytes,
+                                  stage_threads=stage_threads)
+        if prefetch_scores is not None and self._cold_count:
+            scores = np.zeros(self._n, np.float64)
+            scores[:] = np.asarray(prefetch_scores, np.float64)
+            scores[: self._hot_count] = 0.0   # hot prefix never staged
+            self._stager.warm(scores)
+        self.bytes_from_hbm = 0
+        self._gather_jit = None
+        self._gather_jit_host = None
+        self._cache = None
+        self._cache_lookup_jit = None
+        self._merge_cached_jit = None
+        self._merge_jit = None
+        return self
+
+    def _fetch_cold(self, local_ids: np.ndarray) -> np.ndarray:
+        """Cold rows by LOCAL id (0 = first cold row) — the tier seam:
+        DRAM-resident numpy for plain features, DRAM-stage-or-disk for
+        store-backed ones (bit-identical rows either way)."""
+        if self._stager is not None:
+            return self._stager.gather(
+                np.asarray(local_ids, np.int64) + self._hot_count)
+        return self._cold[local_ids]
+
+    def stage_ahead(self, ids) -> None:
+        """Hint upcoming global ``ids`` to the DRAM stager (async; no-op
+        for DRAM-resident features).  The loader calls this at sample
+        *dispatch* so staging overlaps the prefetch window."""
+        if self._stager is None:
+            return
+        ids = np.asarray(ids).reshape(-1)
+        ids = ids[ids >= 0].astype(np.int64)
+        if self._id2index_np is not None:
+            ids = self._id2index_np[ids].astype(np.int64)
+        self._stager.stage_ahead(ids[ids >= self._hot_count])
+
+    def store_stats(self) -> Optional[dict]:
+        """Tier byte counters for store-backed features (``glt.store.*``
+        seed): stager counters + this feature's hot-tier bytes."""
+        if self._stager is None:
+            return None
+        stats = self._stager.stats()
+        stats["bytes_from_hbm"] = self.bytes_from_hbm
+        return stats
+
+    def close(self) -> None:
+        """Release the staging threads of a store-backed feature."""
+        if self._stager is not None:
+            self._stager.close()
 
     def _gather_hot_impl(self, hot, id2index, ids):
         from ..ops.dedup_gather import dedup_gather_rows
@@ -169,11 +265,28 @@ class Feature:
         cache MISSES.  Costs one device->host fetch of the ``[B]`` hit
         mask per gather (the host must know which rows to stage — the
         same sync the loader's overflow check already pays).
+
+        A fully device-resident store (``split_ratio == 1.0``) has
+        nothing to cache: the call warns and no-ops instead of failing.
+        ``capacity`` above the cold-row count would only pad a cache no
+        gather can ever fill past the cold tier itself, so it clamps
+        (with a warning) to the cold-row count.
         """
-        if self._cold.shape[0] == 0:
-            raise ValueError(
-                "cold cache needs a host tier (split_ratio < 1.0)")
-        self._cache = cache_init(self._cold.shape[0], int(capacity),
+        if self._cold_count == 0:
+            warnings.warn(
+                "enable_cold_cache is a no-op at split_ratio == 1.0: "
+                "every row is already HBM-resident, there is no cold "
+                "tier to cache", RuntimeWarning, stacklevel=2)
+            return
+        capacity = int(capacity)
+        if capacity > self._cold_count:
+            warnings.warn(
+                f"cold-cache capacity {capacity} exceeds the "
+                f"{self._cold_count}-row cold tier; clamping (a larger "
+                f"cache can never hold more than every cold row)",
+                RuntimeWarning, stacklevel=2)
+            capacity = self._cold_count
+        self._cache = cache_init(self._cold_count, capacity,
                                  self._dim, self.dtype)
         self._cache_lookup_jit = jax.jit(cache_lookup)
 
@@ -196,7 +309,7 @@ class Feature:
         loader stages it before the jitted train step).  Padding rows are
         zeros.
         """
-        if self._cold.shape[0] == 0:
+        if self._cold_count == 0:
             if isinstance(ids, jax.core.Tracer):
                 # Already inside an enclosing jit: trace inline.
                 return self._gather_hot_impl(self._hot, self._id2index,
@@ -240,12 +353,14 @@ class Feature:
         cold_pos = np.nonzero(cold_mask)[0]
         # Host moves ONLY the cold rows (was: full-batch np.take of both
         # tiers + masked merge).
-        cold_np = self._cold[idx[cold_pos] - self._hot_count]
+        self.bytes_from_hbm += int(hot_mask.sum()) * self._dim \
+            * jnp.dtype(self.dtype).itemsize
+        cold_np = self._fetch_cold(idx[cold_pos] - self._hot_count)
         cap = _pow2_pad(cold_pos.shape[0])
         b = ids_np.shape[0]
         pos_pad = np.full((cap,), b, np.int32)      # b = out-of-range: drop
         pos_pad[: cold_pos.shape[0]] = cold_pos
-        rows_pad = np.zeros((cap, self._dim), self._cold.dtype)
+        rows_pad = np.zeros((cap, self._dim), self._cold_np_dtype)
         rows_pad[: cold_pos.shape[0]] = cold_np
         return self._merge_tiered(
             jnp.asarray(np.where(hot_mask, idx, 0), jnp.int32),
@@ -287,11 +402,13 @@ class Feature:
         hit_np = np.asarray(hit)                      # the one sync
         miss_mask = cold_mask & ~hit_np
         miss_pos = np.nonzero(miss_mask)[0]
-        miss_np = self._cold[idx[miss_pos] - self._hot_count]
+        self.bytes_from_hbm += int(hot_mask.sum()) * self._dim \
+            * jnp.dtype(self.dtype).itemsize
+        miss_np = self._fetch_cold(idx[miss_pos] - self._hot_count)
         cap = _pow2_pad(miss_pos.shape[0])
         pos_pad = np.full((cap,), b, np.int32)
         pos_pad[: miss_pos.shape[0]] = miss_pos
-        rows_pad = np.zeros((cap, self._dim), self._cold.dtype)
+        rows_pad = np.zeros((cap, self._dim), self._cold_np_dtype)
         rows_pad[: miss_pos.shape[0]] = miss_np
 
         if self._merge_cached_jit is None:
@@ -333,14 +450,23 @@ class Feature:
         return self.gather(jnp.atleast_1d(jnp.asarray(ids)))
 
     def cpu_get(self, ids: np.ndarray) -> np.ndarray:
-        """Pure host-side lookup (cf. feature.py:156 ``cpu_get``)."""
+        """Pure host-side lookup (cf. feature.py:156 ``cpu_get``).
+
+        Store-backed features (:meth:`from_store`) read the rows straight
+        off the disk store — no full DRAM materialization exists to index
+        — bypassing the stager so inspection reads never churn the
+        residency set.
+        """
         require_int32_ids(ids)
         ids = np.atleast_1d(np.asarray(ids))
         valid = ids >= 0
         idx = np.where(valid, ids, 0)
         if self._id2index is not None:
             idx = np.asarray(self._id2index)[idx]
-        rows = self._host_full[idx]
+        if self._host_full is None:
+            rows = self._store.read_rows(np.asarray(idx, np.int64))
+        else:
+            rows = self._host_full[idx]
         rows = np.where(valid[:, None], rows, 0)
         return rows
 

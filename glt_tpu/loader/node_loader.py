@@ -154,12 +154,21 @@ class NodeLoader:
         self._epoch += 1
         pending = deque()
         batches = self._epoch_seed_batches()
+        feat = self.data.get_node_feature() if self.data is not None else None
+        stage = getattr(feat, "stage_ahead", None)
         try:
             while True:
                 while len(pending) < self.prefetch:
                     seeds = next(batches, None)
                     if seeds is None:
                         break
+                    if stage is not None:
+                        # Disk-tier hint (glt_tpu.store): seeds are
+                        # host-side at dispatch, so this costs no device
+                        # sync; the DRAM stager pulls their rows off
+                        # disk while the batch sits in the prefetch
+                        # queue.  No-op for DRAM-resident features.
+                        stage(np.asarray(seeds))
                     with _span("loader.sample_dispatch"), \
                             _M_SAMPLE_MS.time():
                         out = self.sampler.sample_from_nodes(

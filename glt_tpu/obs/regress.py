@@ -77,6 +77,16 @@ EXPLICIT_DIRECTIONS: Dict[str, int] = {
     "serving_rps_per_request": NEUTRAL,
     "serving_overload_reject_frac": NEUTRAL,
     "serving_offered_rps": NEUTRAL,
+    # Disk feature tier (benchmarks/bench_cold_tier.py, docs/storage.md):
+    # DRAM residency should absorb traffic (hit rate up-good); epoch
+    # wall time down-good; raw tier byte counts are workload readings.
+    "dram_hit_rate": UP,
+    "store_epoch_ms": DOWN,
+    "disk_bytes_per_epoch": NEUTRAL,
+    "bytes_from_dram": NEUTRAL,
+    "bytes_from_disk": NEUTRAL,
+    "bytes_from_hbm": NEUTRAL,
+    "store_budget_bytes": NEUTRAL,
     # Environment / configuration readings — not better or worse.
     "tunnel_rtt_ms": NEUTRAL,
     "dedup_ratio": NEUTRAL,
@@ -129,6 +139,9 @@ ASPIRATIONS: Dict[str, Tuple[str, float]] = {
     # p99 should stay interactive (tracked so a flat miss flags stuck).
     "serving_coalesce_speedup": (">=", 1.5),
     "serving_p99_ms": ("<=", 50.0),
+    # Disk tier (ISSUE 12): the warmed stager must absorb at least half
+    # of cold traffic in DRAM on the skewed bench workload.
+    "dram_hit_rate": (">=", 0.5),
 }
 
 

@@ -159,6 +159,40 @@ def shard_feature_tiered(feature: np.ndarray, num_shards: int,
                                 hot_per_shard=h, num_shards=num_shards)
 
 
+def shard_feature_tiered_from_store(store, num_shards: int,
+                                    hot_ratio: float, dtype=None
+                                    ) -> TieredShardedFeature:
+    """Third-tier constructor (glt_tpu.store, docs/storage.md): hot
+    prefixes load straight off a shard-major
+    :class:`~glt_tpu.store.disk.DiskFeatureStore`; the cold remainder
+    STAYS on disk.
+
+    The store holds the full ``[num_shards * nodes_per_shard, d]``
+    matrix in the :class:`TieredShardedFeature` id layout (shard ``s``
+    row ``r`` at global row ``s * c + r``), so the same file backs both
+    the hot loads here and a
+    :class:`~glt_tpu.store.stager.DiskColdStore` — which you MUST pass
+    as the pipeline's ``cold_store`` (the returned ``cold`` field is a
+    zero-row placeholder; :class:`~glt_tpu.parallel.dist_train.
+    TieredTrainPipeline` refuses to default it to a
+    :class:`HostColdStore`).
+    """
+    if store.num_rows % num_shards:
+        raise ValueError(
+            f"store rows {store.num_rows} not divisible by {num_shards} "
+            f"shards — pad the matrix to the shard grid before writing")
+    c = store.num_rows // num_shards
+    h = min(c, max(1, int(round(c * float(hot_ratio)))))
+    hot = np.empty((num_shards, h, store.dim), store.dtype)
+    for s in range(num_shards):
+        hot[s] = store.read_rows(
+            np.arange(s * c, s * c + h, dtype=np.int64))
+    arr = jnp.asarray(hot) if dtype is None else jnp.asarray(hot, dtype)
+    cold = np.zeros((num_shards, 0, store.dim), store.dtype)
+    return TieredShardedFeature(hot=arr, cold=cold, nodes_per_shard=c,
+                                hot_per_shard=h, num_shards=num_shards)
+
+
 def exchange_gather_hot(
     ids: jnp.ndarray,
     hot_rows: jnp.ndarray,

@@ -614,12 +614,22 @@ class _ColdStagePipeline:
             losses.append(loss)
             accs.append(acc)
             trained(pending[0], state)
+        # Epoch-boundary seam for tier-aware cold stores (glt_tpu.store):
+        # a DiskColdStore snapshots + publishes its per-epoch glt.store.*
+        # gauges here (bytes_from_dram/disk, hit rate, stage depth).
+        pub = getattr(getattr(self, "cold_store", None),
+                      "publish_epoch_stats", None)
+        if pub is not None:
+            pub()
         return state, losses, accs
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)
         if self._gather_pool is not None:
             self._gather_pool.shutdown(wait=False)
+        closer = getattr(getattr(self, "cold_store", None), "close", None)
+        if closer is not None:
+            closer()
 
     def __del__(self):
         try:
@@ -659,6 +669,17 @@ class TieredTrainPipeline(_ColdStagePipeline):
         # This process's contiguous shard block (all shards when
         # single-process); the cold store serves exactly these.
         self._local = multihost.local_shard_range(mesh, axis_name)
+        if (cold_store is None and f.cold.shape[1] == 0
+                and f.nodes_per_shard > f.hot_per_shard):
+            # shard_feature_tiered_from_store leaves ``cold`` as a
+            # zero-row placeholder: the cold tier lives on disk.  A
+            # defaulted HostColdStore over it would serve silent zero
+            # rows for every cold request — refuse instead.
+            raise ValueError(
+                "TieredShardedFeature has an empty host cold tier but "
+                f"{f.nodes_per_shard - f.hot_per_shard} cold rows per "
+                "shard — pass the DiskColdStore backing it as "
+                "cold_store= (see docs/storage.md)")
         self.cold_store = cold_store or HostColdStore(
             f, shard_ids=self._local)
         self._init_pools(stage_threads, "glt-cold")

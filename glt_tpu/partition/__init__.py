@@ -7,7 +7,7 @@ from .contiguous import (
 )
 from .dist_random_partitioner import DistRandomPartitioner, hash_partition
 from .dist_table_partitioner import DistTableRandomPartitioner
-from .frequency_partitioner import FrequencyPartitioner
+from .frequency_partitioner import FrequencyPartitioner, residency_scores
 from .random_partitioner import RandomPartitioner
 
 __all__ = [
@@ -23,4 +23,5 @@ __all__ = [
     "load_partition",
     "relabel_rows",
     "relabel_topology",
+    "residency_scores",
 ]

@@ -18,6 +18,36 @@ import numpy as np
 from .base import PartitionerBase
 
 
+def residency_scores(probs: Sequence[np.ndarray],
+                     normalize: bool = True) -> np.ndarray:
+    """Collapse per-partition access-probability vectors into one global
+    ``[num_nodes]`` float64 hotness score — the prefetch oracle for the
+    disk tier's DRAM stager (:meth:`glt_tpu.store.stager.DramStager.warm`).
+
+    The same ``sample_prob`` statistics that drive hotness-aware
+    partitioning rank which rows deserve DRAM residency: a node's score
+    is its access probability summed over every rank that touches it.
+    With ``normalize`` the result is scaled to a max of 1.0 so budgets
+    and thresholds compare across graphs.
+    """
+    if not probs:
+        raise ValueError("residency_scores: need at least one "
+                         "probability vector")
+    score = np.zeros_like(np.asarray(probs[0], np.float64))
+    for p in probs:
+        p = np.asarray(p, np.float64)
+        if p.shape != score.shape:
+            raise ValueError(
+                f"residency_scores: shape mismatch {p.shape} vs "
+                f"{score.shape}")
+        score += p
+    if normalize:
+        peak = score.max()
+        if peak > 0:
+            score /= peak
+    return score
+
+
 class FrequencyPartitioner(PartitionerBase):
     """Args beyond :class:`PartitionerBase`:
 

@@ -1,0 +1,41 @@
+"""glt_tpu.store — disk-backed third feature tier with async DRAM prefetch.
+
+The storage stack below the HBM hot tier and the host-DRAM cold tier
+(docs/storage.md):
+
+* :class:`DiskFeatureStore` / :func:`write_feature_store` — a raw
+  row-major file + checksummed manifest (GLT011 atomic publish), served
+  through mmap with GIL-releasing row-chunked reads;
+* :class:`DramStager` — a bounded, *enforced* DRAM budget filled ahead
+  of the sampler by async staging threads under a BGL-style frequency
+  residency policy, with the partition book's access statistics as the
+  prefetch oracle (:meth:`DramStager.warm`);
+* :class:`DiskColdStore` — the ``HostColdStore`` drop-in that slots the
+  disk tier under :class:`~glt_tpu.parallel.dist_train.
+  TieredTrainPipeline` and the fused scanned epoch unchanged;
+* :func:`publish_store_stats` — ``glt.store.*`` gauges through the obs
+  registry.
+"""
+from .disk import (
+    DATA_NAME,
+    FORMAT_VERSION,
+    MANIFEST_NAME,
+    DiskFeatureStore,
+    StoreCorruptError,
+    StoreError,
+    write_feature_store,
+)
+from .stager import DiskColdStore, DramStager, publish_store_stats
+
+__all__ = [
+    "DATA_NAME",
+    "FORMAT_VERSION",
+    "MANIFEST_NAME",
+    "DiskFeatureStore",
+    "StoreCorruptError",
+    "StoreError",
+    "write_feature_store",
+    "DiskColdStore",
+    "DramStager",
+    "publish_store_stats",
+]

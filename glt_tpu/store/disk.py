@@ -1,0 +1,238 @@
+"""Disk-resident feature tier: raw row-major file + checksummed manifest.
+
+The third storage tier of the engine (docs/storage.md): below the HBM
+hot tier (:mod:`glt_tpu.data.feature_cache`) and the host-DRAM cold tier
+(:class:`~glt_tpu.parallel.dist_feature.HostColdStore`) sits an
+NVMe/disk-backed store holding the FULL feature matrix, so "features >>
+DRAM" (GIDS / PyTorch-Direct scale, PAPERS.md) stops being a
+constructor-time constraint.
+
+Layout is deliberately dumb: one ``features.bin`` of C-contiguous
+``[num_rows, dim]`` rows next to one ``manifest.json`` carrying dtype,
+shape, a format version and the file's sha256.  Dumb layout is what makes
+the serving path fast — a row read is one offset computation and one
+page-cache copy, no decompression, no framing; the OS page cache IS the
+block cache and :class:`~glt_tpu.store.stager.DramStager` is the
+explicitly-budgeted row cache above it.
+
+Publish discipline is the GLT011 contract (``glt_tpu/ckpt/store.py``):
+the store directory is fully written under a private ``.tmp-*`` name and
+published with ONE ``os.replace``; a writer SIGKILLed mid-write leaves
+only a tmp directory readers never open.  Torn *disk* state after
+publish (truncation, bit rot) surfaces as a structured
+:class:`StoreCorruptError` — at open time via the cheap size check, and
+on demand via :meth:`DiskFeatureStore.verify` (full checksum).
+
+Reads go through ``np.memmap`` fancy indexing in row chunks: numpy
+releases the GIL during the copy, so chunks fan out across a
+ThreadPoolExecutor exactly like ``HostColdStore.serve_into`` — the same
+``(pool, row_chunk)`` contract, one tier further down.  Fault injection
+(:class:`~glt_tpu.testing.faults.FaultPlan` ``fail_disk_read_at`` /
+``delay_disk_read``) hooks every chunk read, so the chaos suite can
+place a read error or stall at an exact point in an epoch.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+FORMAT_VERSION = 1
+DATA_NAME = "features.bin"
+MANIFEST_NAME = "manifest.json"
+
+
+class StoreError(RuntimeError):
+    """Feature-store read/write failed (missing, malformed, out of range)."""
+
+
+class StoreCorruptError(StoreError):
+    """The store file contradicts its manifest: truncated or bit-rotted.
+
+    Raised at open time (size mismatch) or by :meth:`DiskFeatureStore.
+    verify` (checksum mismatch).  Structured by design — a corrupt tier
+    must never surface as a zero-row batch."""
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fsync_dir(path: str) -> None:
+    # Best-effort directory fsync (some filesystems refuse dir fds).
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_feature_store(root: str, array: np.ndarray) -> str:
+    """Write ``array`` (``[N, d]``) as a feature store directory at ``root``.
+
+    Atomic publish (GLT011): everything lands under ``.tmp-<pid>`` next
+    to ``root`` and ONE ``os.replace`` makes it visible.  Returns
+    ``root``.
+    """
+    array = np.asarray(array)
+    if array.ndim == 1:
+        array = array[:, None]
+    if array.ndim != 2:
+        raise StoreError(
+            f"feature store rows must be [N, d]; got shape {array.shape}")
+    root = os.path.abspath(root)
+    if os.path.exists(root):
+        raise StoreError(f"feature store target already exists: {root}")
+    parent = os.path.dirname(root) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = os.path.join(parent, f".tmp-{os.path.basename(root)}-{os.getpid()}")
+    os.makedirs(tmp)
+    data_path = os.path.join(tmp, DATA_NAME)
+    np.ascontiguousarray(array).tofile(data_path)
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "dtype": np.dtype(array.dtype).str,
+        "shape": [int(array.shape[0]), int(array.shape[1])],
+        "sha256": _sha256(data_path),
+    }
+    with open(os.path.join(tmp, MANIFEST_NAME), "w") as fh:
+        json.dump(manifest, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    with open(data_path, "rb") as fh:
+        os.fsync(fh.fileno())
+    _fsync_dir(tmp)
+    os.replace(tmp, root)
+    _fsync_dir(parent)
+    return root
+
+
+class DiskFeatureStore:
+    """mmap-served row reads over one published feature-store directory.
+
+    The disk-level analogue of :class:`~glt_tpu.parallel.dist_feature.
+    HostColdStore`: :meth:`gather_into` has the same ``(out, row_ids,
+    pool, row_chunk)`` shape and the same GIL-releasing chunked-copy
+    behavior, one tier down.  Thread-safe: the byte counters are
+    lock-protected and the memmap is read-only.
+
+    Args:
+      root: published store directory (``features.bin`` + manifest).
+      faults: optional :class:`~glt_tpu.testing.faults.FaultPlan`; its
+        ``on_disk_read`` hook fires before every chunk read.
+      verify: checksum the data file against the manifest at open
+        (full-file read — the cheap size check always runs).
+    """
+
+    def __init__(self, root: str, faults=None, verify: bool = False):
+        self.root = os.path.abspath(root)
+        mpath = os.path.join(self.root, MANIFEST_NAME)
+        try:
+            with open(mpath) as fh:
+                man = json.load(fh)
+        except (OSError, ValueError) as e:
+            raise StoreError(f"unreadable store manifest {mpath}: {e}")
+        if man.get("format_version") != FORMAT_VERSION:
+            raise StoreError(
+                f"store format {man.get('format_version')!r} != "
+                f"{FORMAT_VERSION} at {self.root}")
+        self.dtype = np.dtype(man["dtype"])
+        shape = man["shape"]
+        self.num_rows, self.dim = int(shape[0]), int(shape[1])
+        self.row_nbytes = self.dim * self.dtype.itemsize
+        self.sha256 = man["sha256"]
+        self._data_path = os.path.join(self.root, DATA_NAME)
+        expected = self.num_rows * self.row_nbytes
+        try:
+            actual = os.path.getsize(self._data_path)
+        except OSError as e:
+            raise StoreError(f"missing store data file: {e}")
+        if actual != expected:
+            raise StoreCorruptError(
+                f"store data file {self._data_path} holds {actual} bytes, "
+                f"manifest says {expected} ([{self.num_rows}, {self.dim}] "
+                f"{self.dtype}) — truncated or torn")
+        self.faults = faults
+        self._arr: Optional[np.ndarray] = None
+        self._lock = threading.Lock()
+        self.bytes_read = 0
+        self.chunk_reads = 0
+
+    def verify(self) -> None:
+        """Full checksum against the manifest (reads the whole file)."""
+        got = _sha256(self._data_path)
+        if got != self.sha256:
+            raise StoreCorruptError(
+                f"store data file {self._data_path} sha256 {got[:12]}… != "
+                f"manifest {self.sha256[:12]}… — bit rot or torn write")
+
+    @property
+    def shape(self):
+        return (self.num_rows, self.dim)
+
+    def _mapped(self) -> np.ndarray:
+        """The read-only memmap view, created lazily (one per store)."""
+        if self._arr is None:
+            self._arr = np.memmap(self._data_path, dtype=self.dtype,
+                                  mode="r", shape=(self.num_rows, self.dim))
+        return self._arr
+
+    def _read_chunk(self, out: np.ndarray, sel: np.ndarray,
+                    row_ids: np.ndarray, lo: int, hi: int) -> None:
+        """One GIL-releasing page-cache copy of rows ``sel[lo:hi]``."""
+        if self.faults is not None:
+            self.faults.on_disk_read()
+        arr = self._mapped()
+        idx = sel[lo:hi]
+        out[idx] = arr[row_ids[idx]]
+        with self._lock:
+            self.bytes_read += int(idx.size) * self.row_nbytes
+            self.chunk_reads += 1
+
+    def gather_into(self, out: np.ndarray, row_ids: np.ndarray,
+                    pool=None, row_chunk: int = 16384) -> list:
+        """Gather ``row_ids`` (< 0 = skip) into ``out`` rows, row-chunked.
+
+        Same contract as ``HostColdStore.serve_into``: with ``pool`` the
+        read splits into ``row_chunk``-row work items and returns their
+        futures (caller awaits); without, it runs inline and returns
+        ``[]``.  Out-of-range ids raise a structured :class:`StoreError`
+        before any byte moves.
+        """
+        row_ids = np.asarray(row_ids)
+        sel = np.where(row_ids >= 0)[0]
+        if sel.size == 0:
+            return []
+        mx = int(row_ids[sel].max())
+        if mx >= self.num_rows:
+            raise StoreError(
+                f"row id {mx} out of range for {self.num_rows}-row store "
+                f"{self.root}")
+        if pool is None:
+            self._read_chunk(out, sel, row_ids, 0, sel.size)
+            return []
+        return [pool.submit(self._read_chunk, out, sel, row_ids,
+                            lo, min(lo + row_chunk, sel.size))
+                for lo in range(0, sel.size, row_chunk)]
+
+    def read_rows(self, row_ids: np.ndarray) -> np.ndarray:
+        """``[len(row_ids), dim]`` rows (zeros at ids < 0)."""
+        row_ids = np.asarray(row_ids)
+        out = np.zeros((row_ids.shape[0], self.dim), self.dtype)
+        self.gather_into(out, row_ids)
+        return out
+
+    def __repr__(self) -> str:
+        return (f"DiskFeatureStore(shape={self.shape}, dtype={self.dtype}, "
+                f"root={self.root!r})")

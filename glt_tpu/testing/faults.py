@@ -99,6 +99,16 @@ class FaultPlan:
     # Only the first N accepted/established connections are faulty;
     # later ones run clean (lets a test end the weather deterministically).
     max_faulty_conns: Optional[int] = None
+    # Disk-tier chaos (glt_tpu.store): chunk reads through a faulty
+    # DiskFeatureStore count 1-based, globally across threads.  The Nth
+    # read raises ``disk_fail_exc`` (an OSError — the EIO class the
+    # store path must surface structurally); reads listed in
+    # ``delay_disk_read`` sleep ``disk_delay_secs`` first (a stalled
+    # staging thread / slow device — the degraded-mode trigger).
+    fail_disk_read_at: Optional[int] = None
+    disk_fail_exc: type = OSError
+    delay_disk_read: Tuple[int, ...] = ()
+    disk_delay_secs: float = 0.0
 
     def __post_init__(self):
         self._lock = threading.Lock()
@@ -107,12 +117,15 @@ class FaultPlan:
         self._puts = 0
         self._train_steps = 0
         self._serving_batches = 0
+        self._disk_reads = 0
         self.injected_drops = 0
         self.injected_failures = 0
         self.injected_corruptions = 0
         self.injected_delays = 0
         self.injected_preemptions = 0
         self.injected_serving_failures = 0
+        self.injected_disk_failures = 0
+        self.injected_disk_delays = 0
 
     # -- endpoint hooks ----------------------------------------------------
     def wrap(self, sock: socket.socket):
@@ -174,6 +187,29 @@ class FaultPlan:
             raise RuntimeError(
                 f"fault injection: serving engine crashed on micro-batch "
                 f"{self.fail_serving_batch}")
+
+    def on_disk_read(self) -> None:
+        """Called by :meth:`glt_tpu.store.disk.DiskFeatureStore.
+        _read_chunk` before every chunk read (``fail_disk_read_at`` /
+        ``delay_disk_read``).  A delay sleeps on the READING thread —
+        stage-ahead workers stall exactly like a slow device; the serve
+        path must degrade around them, never wait on them."""
+        if self.fail_disk_read_at is None and not self.delay_disk_read:
+            return
+        with self._lock:
+            self._disk_reads += 1
+            n = self._disk_reads
+            fail = n == self.fail_disk_read_at
+            delay = n in self.delay_disk_read
+            if fail:
+                self.injected_disk_failures += 1
+            if delay:
+                self.injected_disk_delays += 1
+        if delay:
+            time.sleep(self.disk_delay_secs)
+        if fail:
+            raise self.disk_fail_exc(
+                f"fault injection: disk read {n} failed")
 
     @property
     def connections(self) -> int:

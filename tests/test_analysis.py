@@ -901,6 +901,115 @@ class TestDispatchInEpochLoop:
 
 
 # ---------------------------------------------------------------------------
+# GLT014 blocking-io-in-epoch-loop
+# ---------------------------------------------------------------------------
+
+class TestBlockingIOInEpochLoop:
+    def test_positive_np_load_in_loop(self):
+        src = """
+        import numpy as np
+
+        def run_scanned_epoch(step, state, paths):
+            for p in paths:
+                rows = np.load(p)
+                state = step(state, rows)
+            return state
+        """
+        fs = findings_for(src, "blocking-io-in-epoch-loop")
+        assert len(fs) == 1
+        assert "stage ahead" in fs[0].message
+
+    def test_positive_memmap_slice_in_loop(self):
+        # The constructor is hoisted above the loop; the slice INSIDE
+        # the loop is the per-batch page fault.
+        src = """
+        import numpy as np
+
+        def run_epoch(step, state, batches, path):
+            mm = np.memmap(path, dtype=np.float32, mode="r")
+            for b in batches:
+                state = step(state, mm[b])
+            return state
+        """
+        fs = findings_for(src, "blocking-io-in-epoch-loop")
+        assert len(fs) == 1
+        assert "page-fault" in fs[0].message
+
+    def test_positive_file_read_in_loop(self):
+        src = """
+        def run_stream_epoch(step, state, fh, n):
+            while n > 0:
+                raw = fh.read(4096)
+                state = step(state, raw)
+                n -= 1
+            return state
+        """
+        fs = findings_for(src, "blocking-io-in-epoch-loop")
+        assert len(fs) == 1
+        assert ".read()" in fs[0].message
+
+    def test_negative_non_epoch_function(self):
+        # Staging helpers read disk by design — only epoch drivers are
+        # in scope.
+        src = """
+        import numpy as np
+
+        def _stage(store, ids, out):
+            for lo in range(0, len(ids), 1024):
+                out[lo:lo + 1024] = np.load(store)[ids[lo:lo + 1024]]
+        """
+        assert findings_for(src, "blocking-io-in-epoch-loop") == []
+
+    def test_negative_read_outside_loop(self):
+        src = """
+        import numpy as np
+
+        def run_scanned_epoch(step, state, path, batches):
+            rows = np.load(path)      # once, at the epoch boundary
+            for b in batches:
+                state = step(state, rows[b])
+            return state
+        """
+        assert findings_for(src, "blocking-io-in-epoch-loop") == []
+
+    def test_transitive_helper_disk_read(self):
+        fs = project_findings({
+            "pkg.store": """
+                import numpy as np
+
+                def load_rows(path, ids):
+                    return np.load(path)[ids]
+            """,
+            "pkg.driver": """
+                from pkg.store import load_rows
+
+                def run_scanned_epoch(step, state, path, batches):
+                    for b in batches:
+                        state = step(state, load_rows(path, b))
+                    return state
+            """,
+        }, "blocking-io-in-epoch-loop")
+        assert len(fs) == 1
+        assert "load_rows" in fs[0].message
+        assert "disk read" in fs[0].message
+
+    def test_suppression(self):
+        src = """
+        import numpy as np
+
+        def run_epoch(step, state, path, batches):
+            for b in batches:
+                # degraded fallback: a failed stage left these rows on
+                # disk, and correctness beats latency here
+                # gltlint: disable-next=blocking-io-in-epoch-loop
+                rows = np.load(path)
+                state = step(state, rows[b])
+            return state
+        """
+        assert findings_for(src, "blocking-io-in-epoch-loop") == []
+
+
+# ---------------------------------------------------------------------------
 # the project engine: symbols, call graph, effects
 # ---------------------------------------------------------------------------
 
@@ -1512,6 +1621,7 @@ def test_rule_registry_complete():
         "lock-order-inversion", "blocking-call-while-holding-lock",
         "span-in-traced-code", "non-atomic-state-publish",
         "unbounded-queue-put", "dispatch-in-epoch-loop",
+        "blocking-io-in-epoch-loop",
     }
 
 

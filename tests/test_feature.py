@@ -110,6 +110,38 @@ class TestFeature:
         dedup = np.asarray(Feature(arr, dedup=True).gather(ids))
         np.testing.assert_array_equal(plain, dedup)
 
+    def test_cold_cache_all_hot_warns_and_noops(self):
+        """ISSUE 12 satellite: at split_ratio == 1.0 there is no cold
+        tier to cache — warn and no-op instead of the old unhelpful
+        ``capacity must be positive``-adjacent ValueError path."""
+        import pytest
+
+        arr = np.arange(12, dtype=np.float32).reshape(6, 2)
+        f = Feature(arr, split_ratio=1.0)
+        with pytest.warns(RuntimeWarning, match="no-op at split_ratio"):
+            f.enable_cold_cache(4)
+        assert f._cache is None
+        np.testing.assert_allclose(
+            np.asarray(f.gather(jnp.array([1, 5]))), arr[[1, 5]])
+
+    def test_cold_cache_capacity_clamped_to_cold_tier(self):
+        """ISSUE 12 satellite: capacity > cold rows clamps (with a
+        warning) instead of allocating dead cache slots; gathers stay
+        exact through the clamped cache."""
+        import pytest
+
+        rng = np.random.default_rng(3)
+        arr = rng.normal(size=(20, 3)).astype(np.float32)
+        f = Feature(arr, split_ratio=0.5)      # 10 cold rows
+        with pytest.warns(RuntimeWarning, match="clamp"):
+            f.enable_cold_cache(64)
+        assert f._cache is not None
+        assert f._cache.capacity == 10
+        ids = np.array([0, 15, 19, -1, 10, 15])
+        want = np.where((ids >= 0)[:, None], arr[np.clip(ids, 0, 19)], 0)
+        for _ in range(2):                     # second pass hits the cache
+            np.testing.assert_allclose(np.asarray(f.gather(ids)), want)
+
 
 class TestReorder:
     def test_hottest_first(self):

@@ -387,10 +387,17 @@ class TestTieredColdCache:
         s = cached.cache_stats()
         assert s["lookups"] > 0 and s["hits"] > 0   # cross-batch reuse
 
-    def test_cache_requires_cold_tier(self):
+    def test_cache_without_cold_tier_warns_and_noops(self):
+        # All-hot features have nothing to cache: warn + no-op (the old
+        # ValueError punished harness code that sets one ratio for a
+        # sweep); gathers stay exact.  tests/test_feature.py covers the
+        # companion capacity-clamp path.
         f = Feature(np.ones((4, 2), np.float32), split_ratio=1.0)
-        with pytest.raises(ValueError, match="cold"):
+        with pytest.warns(RuntimeWarning, match="no-op at split_ratio"):
             f.enable_cold_cache(4)
+        assert f._cache is None
+        np.testing.assert_array_equal(
+            np.asarray(f.gather(np.array([0, 3]))), np.ones((2, 2)))
 
 
 @pytest.mark.slow

@@ -1,0 +1,698 @@
+"""glt_tpu.store tests: disk format, DRAM stager, three-tier Feature,
+DiskColdStore pipeline parity, and the disk-tier chaos contract
+(ISSUE 12 — docs/storage.md).
+
+The load-bearing invariants:
+
+* the disk tier is **bit-identical** to the all-DRAM path (unit gathers,
+  Feature.from_store, and a full TieredTrainPipeline epoch);
+* ``dram_budget_bytes`` is **enforced** — resident bytes never exceed it
+  no matter the churn;
+* faults are **structural**: a truncated file / failed read raises a
+  typed error, a stalled staging thread degrades to synchronous fetch —
+  never a hang, never a silent zero-row batch.
+"""
+import json
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import Mesh
+
+from glt_tpu.data.feature import Feature
+from glt_tpu.distributed import DistDataset
+from glt_tpu.models import GraphSAGE
+from glt_tpu.obs import metrics
+from glt_tpu.parallel import (
+    DistNeighborSampler,
+    TieredTrainPipeline,
+    init_dist_state,
+    make_tiered_train_step,
+)
+from glt_tpu.parallel.dist_feature import (
+    HostColdStore,
+    shard_feature_tiered,
+    shard_feature_tiered_from_store,
+)
+from glt_tpu.partition import RandomPartitioner, residency_scores
+from glt_tpu.store import (
+    DATA_NAME,
+    MANIFEST_NAME,
+    DiskColdStore,
+    DiskFeatureStore,
+    DramStager,
+    StoreCorruptError,
+    StoreError,
+    publish_store_stats,
+    write_feature_store,
+)
+from glt_tpu.testing.faults import FaultPlan
+
+
+def _write(tmp_path, n=64, d=8, seed=0, name="store", dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    arr = rng.normal(size=(n, d)).astype(dtype)
+    root = str(tmp_path / name)
+    write_feature_store(root, arr)
+    return root, arr
+
+
+# ---------------------------------------------------------------------------
+# DiskFeatureStore: format, manifest, structured failure
+# ---------------------------------------------------------------------------
+class TestDiskFeatureStore:
+    def test_write_read_roundtrip(self, tmp_path):
+        root, arr = _write(tmp_path, n=48, d=6)
+        store = DiskFeatureStore(root, verify=True)
+        assert store.num_rows == 48 and store.dim == 6
+        assert store.shape == (48, 6)
+        assert store.dtype == np.float32
+        assert store.row_nbytes == 6 * 4
+        ids = np.array([0, 47, 13, 13, 7])
+        np.testing.assert_array_equal(store.read_rows(ids), arr[ids])
+        assert store.bytes_read == ids.size * store.row_nbytes
+        man = json.load(open(os.path.join(root, MANIFEST_NAME)))
+        assert man["shape"] == [48, 6]
+        assert man["sha256"] == store.sha256
+
+    def test_1d_array_promoted_to_column(self, tmp_path):
+        arr = np.arange(10, dtype=np.float32)
+        root = str(tmp_path / "col")
+        write_feature_store(root, arr)
+        store = DiskFeatureStore(root)
+        assert store.shape == (10, 1)
+        np.testing.assert_array_equal(store.read_rows(np.array([3, 9])),
+                                      arr[[3, 9]][:, None])
+
+    def test_ndim3_rejected(self, tmp_path):
+        with pytest.raises(StoreError, match=r"\[N, d\]"):
+            write_feature_store(str(tmp_path / "bad"),
+                                np.zeros((2, 2, 2), np.float32))
+
+    def test_refuses_existing_target(self, tmp_path):
+        root, _ = _write(tmp_path)
+        with pytest.raises(StoreError, match="already exists"):
+            write_feature_store(root, np.zeros((2, 2), np.float32))
+
+    def test_atomic_publish_leaves_no_tmp(self, tmp_path):
+        _write(tmp_path)
+        leftovers = [p for p in os.listdir(tmp_path) if ".tmp" in p]
+        assert leftovers == []
+
+    def test_negative_ids_leave_out_untouched(self, tmp_path):
+        root, arr = _write(tmp_path, n=16, d=4)
+        store = DiskFeatureStore(root)
+        ids = np.array([3, -1, 8, -1])
+        out = np.full((4, 4), 7.0, np.float32)
+        store.gather_into(out, ids)
+        np.testing.assert_array_equal(out[[0, 2]], arr[[3, 8]])
+        assert (out[[1, 3]] == 7.0).all()
+        # read_rows zeroes the skipped slots instead
+        got = store.read_rows(ids)
+        assert (got[[1, 3]] == 0).all()
+        np.testing.assert_array_equal(got[[0, 2]], arr[[3, 8]])
+
+    def test_out_of_range_structured_and_no_partial_write(self, tmp_path):
+        root, _ = _write(tmp_path, n=16, d=4)
+        store = DiskFeatureStore(root)
+        out = np.full((3, 4), 7.0, np.float32)
+        with pytest.raises(StoreError, match="out of range"):
+            store.gather_into(out, np.array([0, 16, 2]))
+        assert (out == 7.0).all()   # validated before any byte moved
+
+    def test_pool_chunked_gather_matches_inline(self, tmp_path):
+        root, arr = _write(tmp_path, n=128, d=5)
+        store = DiskFeatureStore(root)
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, 128, size=70)
+        ids[::9] = -1
+        out = np.zeros((70, 5), np.float32)
+        with ThreadPoolExecutor(4) as pool:
+            futs = store.gather_into(out, ids, pool=pool, row_chunk=16)
+            assert len(futs) > 1
+            for fu in futs:
+                fu.result()
+        np.testing.assert_array_equal(out, store.read_rows(ids))
+        np.testing.assert_array_equal(
+            out[ids >= 0], arr[ids[ids >= 0]])
+
+    def test_truncated_file_structured_error(self, tmp_path):
+        root, _ = _write(tmp_path)
+        data = os.path.join(root, DATA_NAME)
+        with open(data, "r+b") as fh:
+            fh.truncate(os.path.getsize(data) - 64)
+        with pytest.raises(StoreCorruptError, match="truncated or torn"):
+            DiskFeatureStore(root)
+
+    def test_verify_detects_bit_rot(self, tmp_path):
+        root, _ = _write(tmp_path)
+        data = os.path.join(root, DATA_NAME)
+        with open(data, "r+b") as fh:  # same size, flipped byte
+            fh.seek(11)
+            b = fh.read(1)
+            fh.seek(11)
+            fh.write(bytes([b[0] ^ 0xFF]))
+        store = DiskFeatureStore(root)   # size check alone passes
+        with pytest.raises(StoreCorruptError, match="bit rot|torn"):
+            store.verify()
+
+    def test_wrong_format_version_rejected(self, tmp_path):
+        root, _ = _write(tmp_path)
+        mpath = os.path.join(root, MANIFEST_NAME)
+        man = json.load(open(mpath))
+        man["format_version"] = 99
+        with open(mpath, "w") as fh:
+            json.dump(man, fh)
+        with pytest.raises(StoreError, match="version"):
+            DiskFeatureStore(root)
+
+    def test_unparseable_manifest_rejected(self, tmp_path):
+        root, _ = _write(tmp_path)
+        with open(os.path.join(root, MANIFEST_NAME), "w") as fh:
+            fh.write("{not json")
+        with pytest.raises(StoreError):
+            DiskFeatureStore(root)
+
+
+# ---------------------------------------------------------------------------
+# DramStager: enforced budget, residency policy, stage-ahead
+# ---------------------------------------------------------------------------
+class TestDramStager:
+    def test_budget_enforced_under_churn(self, tmp_path):
+        root, arr = _write(tmp_path, n=256, d=8)  # 8 KiB of rows
+        store = DiskFeatureStore(root)
+        budget = 32 * store.row_nbytes            # DRAM holds 1/8 of them
+        stager = DramStager(store, budget)
+        assert stager.capacity == 32
+        rng = np.random.default_rng(2)
+        try:
+            for _ in range(6):
+                ids = rng.integers(-1, 256, size=64)
+                got = stager.gather(ids)
+                want = np.where((ids >= 0)[:, None],
+                                arr[np.clip(ids, 0, 255)], 0)
+                np.testing.assert_array_equal(got, want)
+                s = stager.stats()
+                assert s["resident_bytes"] <= budget
+                assert stager._buf.nbytes <= budget
+            s = stager.stats()
+            assert s["hits"] > 0 and s["misses"] > 0
+            assert s["bytes_from_dram"] == s["hits"] * store.row_nbytes
+        finally:
+            stager.close()
+
+    def test_zero_capacity_budget_raises(self, tmp_path):
+        root, _ = _write(tmp_path, n=8, d=8)
+        store = DiskFeatureStore(root)
+        with pytest.raises(ValueError, match="zero"):
+            DramStager(store, store.row_nbytes - 1)
+
+    def test_warm_oracle_then_all_hits(self, tmp_path):
+        root, arr = _write(tmp_path, n=64, d=4)
+        store = DiskFeatureStore(root)
+        stager = DramStager(store, 16 * store.row_nbytes)
+        try:
+            scores = np.zeros(64)
+            hot = np.array([5, 9, 17, 33, 60])
+            scores[hot] = [5, 4, 3, 2, 1]
+            staged = stager.warm(scores)
+            assert staged == 16      # fills to capacity
+            disk_before = stager.stats()["bytes_from_disk"]
+            got = stager.gather(hot)
+            np.testing.assert_array_equal(got, arr[hot])
+            s = stager.stats()
+            assert s["hits"] == hot.size and s["misses"] == 0
+            assert s["bytes_from_disk"] == disk_before  # no demand faults
+        finally:
+            stager.close()
+
+    def test_warm_shape_mismatch_raises(self, tmp_path):
+        root, _ = _write(tmp_path, n=16, d=4)
+        stager = DramStager(DiskFeatureStore(root), 4 * 16)
+        try:
+            with pytest.raises(ValueError, match="oracle scores"):
+                stager.warm(np.zeros(8))
+        finally:
+            stager.close()
+
+    def test_stage_ahead_installs_for_later_hits(self, tmp_path):
+        root, arr = _write(tmp_path, n=64, d=4)
+        store = DiskFeatureStore(root)
+        stager = DramStager(store, 16 * store.row_nbytes)
+        try:
+            ids = np.array([1, 8, 40, 63])
+            stager.stage_ahead(ids).result()
+            got = stager.gather(ids)
+            np.testing.assert_array_equal(got, arr[ids])
+            s = stager.stats()
+            assert s["hits"] == ids.size and s["misses"] == 0
+            assert s["staged_rows"] == ids.size
+            assert s["stage_depth"] == 0 and s["stage_depth_max"] >= 1
+        finally:
+            stager.close()
+
+    def test_pool_gather_installs_and_matches(self, tmp_path):
+        root, arr = _write(tmp_path, n=128, d=4)
+        store = DiskFeatureStore(root)
+        stager = DramStager(store, 64 * store.row_nbytes)
+        try:
+            ids = np.arange(0, 48)
+            out = np.zeros((ids.size, 4), np.float32)
+            with ThreadPoolExecutor(4) as pool:
+                futs = stager.gather_into(out, ids, pool=pool, row_chunk=8)
+                for fu in futs:
+                    fu.result()
+            np.testing.assert_array_equal(out, arr[ids])
+            # the completion callback installed every miss
+            deadline = time.time() + 5
+            while stager.resident_rows() < ids.size:
+                assert time.time() < deadline, "install callback never ran"
+                time.sleep(0.01)
+            np.testing.assert_array_equal(stager.gather(ids), arr[ids])
+            assert stager.stats()["hits"] == ids.size
+        finally:
+            stager.close()
+
+    def test_epoch_stats_delta_resets(self, tmp_path):
+        root, _ = _write(tmp_path, n=32, d=4)
+        store = DiskFeatureStore(root)
+        stager = DramStager(store, 8 * store.row_nbytes)
+        try:
+            stager.gather(np.array([0, 1, 2]))
+            e1 = stager.epoch_stats()
+            assert e1["misses"] == 3
+            e2 = stager.epoch_stats()   # delta since e1: nothing happened
+            assert e2["hits"] == 0 and e2["misses"] == 0
+            assert e2["capacity_rows"] == 8   # snapshot fields survive
+        finally:
+            stager.close()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: the disk-tier failure contract (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+class TestDiskChaos:
+    def test_demand_read_error_is_structural(self, tmp_path):
+        root, _ = _write(tmp_path, n=32, d=4)
+        plan = FaultPlan(fail_disk_read_at=1)
+        store = DiskFeatureStore(root, faults=plan)
+        stager = DramStager(store, 8 * store.row_nbytes)
+        try:
+            with pytest.raises(OSError, match="fault injection"):
+                stager.gather(np.array([0, 1, 2]))
+            assert plan.injected_disk_failures == 1
+            assert stager.resident_rows() == 0   # nothing cached from it
+            # the store recovers once the fault is spent
+            np.testing.assert_array_equal(
+                stager.gather(np.array([5])),
+                DiskFeatureStore(root).read_rows(np.array([5])))
+        finally:
+            stager.close()
+
+    def test_failed_chunk_vetoes_dram_install(self, tmp_path):
+        root, arr = _write(tmp_path, n=64, d=4)
+        plan = FaultPlan(fail_disk_read_at=2)
+        store = DiskFeatureStore(root, faults=plan)
+        stager = DramStager(store, 64 * store.row_nbytes)
+        try:
+            ids = np.arange(32)
+            out = np.zeros((32, 4), np.float32)
+            with ThreadPoolExecutor(2) as pool:
+                futs = stager.gather_into(out, ids, pool=pool, row_chunk=8)
+                assert len(futs) == 4
+                errs = []
+                for fu in futs:
+                    try:
+                        fu.result()
+                    except OSError as e:
+                        errs.append(e)
+            assert len(errs) == 1 and "fault injection" in str(errs[0])
+            assert plan.injected_disk_failures == 1
+            # never cache rows a failed read left unfilled
+            assert stager.resident_rows() == 0
+        finally:
+            stager.close()
+
+    def test_stalled_staging_degrades_not_hangs(self, tmp_path):
+        root, arr = _write(tmp_path, n=64, d=4)
+        plan = FaultPlan(delay_disk_read=(1,), disk_delay_secs=2.0)
+        store = DiskFeatureStore(root, faults=plan)
+        stager = DramStager(store, 16 * store.row_nbytes)
+        try:
+            ids = np.array([3, 9, 27])
+            fut = stager.stage_ahead(ids)    # disk read #1: stalls 2s
+            deadline = time.time() + 5
+            while plan.injected_disk_delays < 1:   # stall entered
+                assert time.time() < deadline, "stage thread never read"
+                time.sleep(0.01)
+            t0 = time.time()
+            got = stager.gather(ids)         # read #2: demand, no delay
+            elapsed = time.time() - t0
+            np.testing.assert_array_equal(got, arr[ids])
+            assert elapsed < 1.0, \
+                f"gather waited on the stalled staging thread ({elapsed:.2f}s)"
+            fut.result()                     # stall finishes cleanly
+            assert stager.stats()["stage_errors"] == 0
+            assert plan.injected_disk_delays == 1
+        finally:
+            stager.close()
+
+    def test_staging_read_error_swallowed_as_degraded(self, tmp_path):
+        root, arr = _write(tmp_path, n=32, d=4)
+        plan = FaultPlan(fail_disk_read_at=1)
+        store = DiskFeatureStore(root, faults=plan)
+        stager = DramStager(store, 8 * store.row_nbytes)
+        try:
+            ids = np.array([1, 2])
+            fut = stager.stage_ahead(ids)    # read #1 fails on the worker
+            assert fut.result() == 0         # recorded, not raised
+            assert stager.stats()["stage_errors"] == 1
+            # degraded mode: same rows demand-fault fine afterwards
+            np.testing.assert_array_equal(stager.gather(ids), arr[ids])
+        finally:
+            stager.close()
+
+
+# ---------------------------------------------------------------------------
+# Feature.from_store: third tier behind the public gather
+# ---------------------------------------------------------------------------
+class TestFeatureFromStore:
+    def test_bit_identity_with_all_dram_path(self, tmp_path):
+        root, arr = _write(tmp_path, n=64, d=8)
+        store = DiskFeatureStore(root)
+        f_dram = Feature(arr, split_ratio=0.25)
+        f_disk = Feature.from_store(store, 8 * store.row_nbytes,
+                                    split_ratio=0.25)
+        try:
+            rng = np.random.default_rng(4)
+            for _ in range(4):
+                ids = rng.integers(-1, 64, size=24)
+                a = np.asarray(f_dram.gather(ids))
+                b = np.asarray(f_disk.gather(ids))
+                assert np.array_equal(a, b)   # bit-identical, not allclose
+        finally:
+            f_disk.close()
+
+    def test_bit_identity_through_cold_cache(self, tmp_path):
+        root, arr = _write(tmp_path, n=64, d=8)
+        store = DiskFeatureStore(root)
+        f_dram = Feature(arr, split_ratio=0.25)
+        f_disk = Feature.from_store(store, 8 * store.row_nbytes,
+                                    split_ratio=0.25)
+        f_dram.enable_cold_cache(8)
+        f_disk.enable_cold_cache(8)
+        try:
+            ids = np.array([0, 20, 63, -1, 20, 41, 5, 63])
+            for _ in range(3):   # repeat: second pass exercises cache hits
+                assert np.array_equal(np.asarray(f_dram.gather(ids)),
+                                      np.asarray(f_disk.gather(ids)))
+        finally:
+            f_disk.close()
+
+    def test_prefetch_scores_warm_dram(self, tmp_path):
+        root, arr = _write(tmp_path, n=64, d=8)
+        store = DiskFeatureStore(root)
+        scores = np.zeros(64)
+        scores[40:48] = 1.0              # oracle: these cold rows are hot
+        f = Feature.from_store(store, 8 * store.row_nbytes,
+                               split_ratio=0.25, prefetch_scores=scores)
+        try:
+            st = f.store_stats()
+            assert st["resident_rows"] == 8        # warmed at construction
+            np.testing.assert_array_equal(
+                np.asarray(f.gather(np.arange(40, 48))), arr[40:48])
+            st = f.store_stats()
+            assert st["hits"] == 8 and st["misses"] == 0
+            assert st["bytes_from_hbm"] == 0       # all-cold batch
+        finally:
+            f.close()
+
+    def test_stage_ahead_noop_on_dram_feature(self):
+        f = Feature(np.ones((8, 2), np.float32), split_ratio=0.5)
+        f.stage_ahead(np.array([1, 6]))    # must not raise
+        assert f.store_stats() is None
+        f.close()                          # also a no-op
+
+    def test_stage_ahead_feeds_stager(self, tmp_path):
+        root, arr = _write(tmp_path, n=64, d=8)
+        store = DiskFeatureStore(root)
+        f = Feature.from_store(store, 16 * store.row_nbytes,
+                               split_ratio=0.25)
+        try:
+            ids = np.array([20, 45, -1, 63])        # global ids, -1 padded
+            f.stage_ahead(ids)
+            deadline = time.time() + 5
+            while f._stager.resident_rows() < 3:
+                assert time.time() < deadline
+                time.sleep(0.01)
+            np.testing.assert_array_equal(
+                np.asarray(f.gather(np.array([20, 45, 63]))),
+                arr[[20, 45, 63]])
+            assert f.store_stats()["hits"] == 3
+        finally:
+            f.close()
+
+
+# ---------------------------------------------------------------------------
+# Residency oracle + metrics publishing
+# ---------------------------------------------------------------------------
+class TestOracleAndMetrics:
+    def test_residency_scores_sums_and_normalizes(self):
+        p0 = np.array([0.5, 0.0, 0.25])
+        p1 = np.array([0.5, 0.5, 0.25])
+        s = residency_scores([p0, p1])
+        np.testing.assert_allclose(s, [1.0, 0.5, 0.5])
+        raw = residency_scores([p0, p1], normalize=False)
+        np.testing.assert_allclose(raw, [1.0, 0.5, 0.5])
+
+    def test_residency_scores_validates(self):
+        with pytest.raises(ValueError, match="at least one"):
+            residency_scores([])
+        with pytest.raises(ValueError, match="shape mismatch"):
+            residency_scores([np.zeros(3), np.zeros(4)])
+
+    def test_publish_store_stats_gauges(self):
+        metrics.reset()
+        metrics.enable()
+        try:
+            publish_store_stats({"hits": 3, "hit_rate": 0.5})
+            snap = metrics.snapshot()
+            assert snap["glt.store.hits"] == 3.0
+            assert snap["glt.store.hit_rate"] == 0.5
+        finally:
+            metrics.disable()
+            metrics.reset()
+
+    def test_publish_noop_when_disabled(self):
+        metrics.reset()
+        publish_store_stats({"hits": 3})
+        # registry registration survives reset(); the VALUE must not move
+        # while metrics are disabled
+        assert metrics.snapshot().get("glt.store.hits", 0.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# DiskColdStore: HostColdStore drop-in + shard-major constructor
+# ---------------------------------------------------------------------------
+class TestDiskColdStore:
+    S, C, H, D = 4, 8, 2, 3
+
+    def _fixture(self, tmp_path):
+        rng = np.random.default_rng(5)
+        arr = rng.normal(size=(self.S * self.C, self.D)).astype(np.float32)
+        f = shard_feature_tiered(arr, self.S, self.H / self.C)
+        assert f.hot_per_shard == self.H
+        root = str(tmp_path / "shardmajor")
+        write_feature_store(root, arr)   # arr IS the tiered id layout
+        return arr, f, DiskFeatureStore(root)
+
+    def test_serve_parity_with_host_cold_store(self, tmp_path):
+        arr, f, store = self._fixture(tmp_path)
+        host = HostColdStore(f)
+        disk = DiskColdStore(store, self.C, self.H,
+                             dram_budget_bytes=4 * store.row_nbytes)
+        try:
+            assert (disk.dim, disk.dtype) == (host.dim, host.dtype)
+            rng = np.random.default_rng(6)
+            for _ in range(3):
+                for s in range(self.S):
+                    req = rng.integers(-1, self.C - self.H, size=10)
+                    assert np.array_equal(disk.serve(s, req),
+                                          host.serve(s, req))
+        finally:
+            disk.close()
+
+    def test_serve_into_pool_parity(self, tmp_path):
+        arr, f, store = self._fixture(tmp_path)
+        host = HostColdStore(f)
+        disk = DiskColdStore(store, self.C, self.H)   # stager-less
+        req = np.array([0, -1, 5, 3, -1, 0])
+        out = np.zeros((req.size, self.D), np.float32)
+        with ThreadPoolExecutor(2) as pool:
+            for fu in disk.serve_into(out, 2, req, pool=pool, row_chunk=2):
+                fu.result()
+        assert np.array_equal(out, host.serve(2, req))
+        disk.close()
+
+    def test_nonlocal_shard_keyerror(self, tmp_path):
+        _, _, store = self._fixture(tmp_path)
+        disk = DiskColdStore(store, self.C, self.H, shard_ids=(0, 1))
+        try:
+            with pytest.raises(KeyError, match="not local"):
+                disk.serve(3, np.array([0]))
+        finally:
+            disk.close()
+
+    def test_from_store_constructor_hot_prefix(self, tmp_path):
+        arr, f, store = self._fixture(tmp_path)
+        f2 = shard_feature_tiered_from_store(store, self.S, self.H / self.C)
+        assert np.array_equal(np.asarray(f2.hot), np.asarray(f.hot))
+        assert f2.cold.shape == (self.S, 0, self.D)   # stays on disk
+        assert f2.nodes_per_shard == self.C
+        assert f2.hot_per_shard == self.H
+
+    def test_from_store_divisibility_error(self, tmp_path):
+        root, _ = _write(tmp_path, n=12, d=2, name="odd")
+        with pytest.raises(ValueError, match="not divisible"):
+            shard_feature_tiered_from_store(DiskFeatureStore(root), 8, 0.25)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: TieredTrainPipeline on the disk tier, bit-identical epochs
+# ---------------------------------------------------------------------------
+N_DEV = 8
+N, CLASSES = 64, 4
+
+
+def _clustered_graph(seed=0):
+    rng = np.random.default_rng(seed)
+    labels = (np.arange(N) % CLASSES).astype(np.int32)
+    src, dst = [], []
+    for c in range(CLASSES):
+        members = np.where(labels == c)[0]
+        for i in members:
+            for j in rng.choice(members, 3, replace=False):
+                src.append(i)
+                dst.append(j)
+    edge_index = np.stack([np.array(src), np.array(dst)])
+    feat = np.eye(CLASSES, dtype=np.float32)[labels]
+    feat = np.concatenate(
+        [feat, rng.normal(0, .1, (N, 4)).astype(np.float32)], 1)
+    return edge_index, feat, labels
+
+
+@pytest.fixture(scope="module")
+def part_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("parts")
+    edge_index, feat, labels = _clustered_graph()
+    RandomPartitioner(str(root), N_DEV, N, edge_index,
+                      node_feat=feat, seed=3).partition()
+    return str(root), edge_index, feat, labels
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N_DEV]), ("shard",))
+
+
+def _tiered_matrix(f):
+    """Reconstruct the full shard-major [S*c, d] matrix a
+    TieredShardedFeature was split from — the exact layout
+    DiskColdStore/shard_feature_tiered_from_store expect on disk."""
+    hot = np.asarray(f.hot)
+    return np.concatenate(
+        [np.concatenate([hot[s], f.cold[s]], 0) for s in range(f.num_shards)],
+        0)
+
+
+class TestPipelineOnDiskTier:
+    def _setup(self, part_dir):
+        root, _, _, labels = part_dir
+        ds = DistDataset.load(root, hot_ratio=0.25, labels=labels)
+        mesh = _mesh()
+        model = GraphSAGE(hidden_features=16, out_features=CLASSES,
+                          num_layers=2, dropout_rate=0.0)
+        tx = optax.adam(1e-2)
+        bs, fanouts = 4, [3, 3]
+        sampler = DistNeighborSampler(ds.graph, mesh, num_neighbors=fanouts,
+                                      batch_size=bs)
+        train = make_tiered_train_step(model, tx, ds.graph, ds.feature,
+                                       ds.labels, mesh, bs)
+        state = init_dist_state(model, tx, ds.graph, ds.feature,
+                                jax.random.PRNGKey(0), fanouts, bs)
+        batches = list(ds.split_seeds(np.arange(N), bs, shuffle=True,
+                                      seed=2))
+        return ds, mesh, sampler, train, state, batches
+
+    def test_epoch_bit_identical_host_vs_disk_cold_store(
+            self, part_dir, tmp_path):
+        ds, mesh, sampler, train, state, batches = self._setup(part_dir)
+        f = ds.feature
+        full = _tiered_matrix(f)
+        root = str(tmp_path / "pipe_store")
+        write_feature_store(root, full)
+        store = DiskFeatureStore(root)
+        # Budget far under the cold tier -> misses, installs, evictions
+        # all on the epoch path, and still bit-identical.
+        disk_cs = DiskColdStore(store, f.nodes_per_shard, f.hot_per_shard,
+                                dram_budget_bytes=8 * store.row_nbytes,
+                                stage_threads=2)
+        pipe_host = TieredTrainPipeline(sampler, train, f, mesh)
+        pipe_disk = TieredTrainPipeline(sampler, train, f, mesh,
+                                        cold_store=disk_cs)
+        try:
+            state_h = state_d = state
+            for epoch in range(2):
+                key = jax.random.PRNGKey(epoch)
+                state_h, loss_h, acc_h = pipe_host.run_epoch(
+                    state_h, batches, key)
+                state_d, loss_d, acc_d = pipe_disk.run_epoch(
+                    state_d, batches, key)
+                assert np.array_equal(np.asarray(loss_h),
+                                      np.asarray(loss_d)), f"epoch {epoch}"
+                assert np.array_equal(np.asarray(acc_h), np.asarray(acc_d))
+            st = disk_cs.stager.stats()
+            assert st["bytes_from_disk"] > 0       # the tier actually ran
+            assert st["resident_bytes"] <= 8 * store.row_nbytes
+        finally:
+            pipe_disk.close()
+            pipe_host.close()
+
+    def test_epoch_publishes_store_gauges(self, part_dir, tmp_path):
+        ds, mesh, sampler, train, state, batches = self._setup(part_dir)
+        f = ds.feature
+        root = str(tmp_path / "gauge_store")
+        write_feature_store(root, _tiered_matrix(f))
+        store = DiskFeatureStore(root)
+        disk_cs = DiskColdStore(store, f.nodes_per_shard, f.hot_per_shard,
+                                dram_budget_bytes=16 * store.row_nbytes)
+        pipe = TieredTrainPipeline(sampler, train, f, mesh,
+                                   cold_store=disk_cs)
+        metrics.reset()
+        metrics.enable()
+        try:
+            pipe.run_epoch(state, batches, jax.random.PRNGKey(0))
+            snap = metrics.snapshot()
+            assert "glt.store.bytes_from_disk" in snap
+            assert "glt.store.hit_rate" in snap
+            assert snap["glt.store.budget_bytes"] == 16 * store.row_nbytes
+        finally:
+            metrics.disable()
+            metrics.reset()
+            pipe.close()
+
+    def test_zero_row_cold_placeholder_refused_without_store(
+            self, part_dir, tmp_path):
+        ds, mesh, sampler, train, state, batches = self._setup(part_dir)
+        f = ds.feature
+        root = str(tmp_path / "guard_store")
+        write_feature_store(root, _tiered_matrix(f))
+        store = DiskFeatureStore(root)
+        f3 = shard_feature_tiered_from_store(
+            store, f.num_shards, f.hot_per_shard / f.nodes_per_shard)
+        with pytest.raises(ValueError, match="cold_store"):
+            TieredTrainPipeline(sampler, train, f3, mesh)
