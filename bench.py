@@ -622,6 +622,56 @@ def main():
         "obs_disabled_overhead_frac": round(obs_overhead_frac, 4),
     })
 
+    # --- per-stage roofline attribution (ISSUE 13): expected-bytes
+    # models (glt_tpu/obs/attrib.py) over the measured per-stage times,
+    # so every pipeline stage — not just gather — reads as a fraction of
+    # the measured memcpy ceiling.  The headline gather_roofline_frac
+    # above stays authoritative (measured payload bytes); the table's
+    # gather row uses the same payload numerator per batch.  train's
+    # bytes prefer XLA's own cost_analysis accounting, falling back to
+    # the analytic 5x-params + 2x-features floor.
+    _progress("stage roofline attribution")
+    from glt_tpu.obs import attrib
+
+    cnt2 = (jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+    t0 = time.perf_counter()
+    for o in gouts:
+        cnt2 = dd(cnt2, o)
+    sync(cnt2[0])
+    dedup_ms = (time.perf_counter() - t0) / len(gouts) * 1e3
+
+    o0 = gouts[0]
+    x0b, y0b = gather_j_c(o0)
+    b_attr = to_batch(o0, x=x0b, y=y0b, batch_size=BATCH)
+    train_bytes = attrib.compiled_cost_bytes(tstep_c, st, b_attr)
+    train_bytes_source = "xla_cost_analysis"
+    if train_bytes is None:
+        train_bytes_source = "analytic"
+        train_bytes = attrib.train_expected_bytes(
+            attrib.param_nbytes(st.params),
+            csampler.node_capacity * dim * 4)
+    stage_ms = {
+        "sample": capped["sample_ms"],
+        "dedup": dedup_ms,
+        "gather": capped["gather_ms"],
+        "train": capped["train_ms"],
+    }
+    stage_bytes = {
+        "sample": attrib.sample_expected_bytes(BATCH, FANOUT),
+        "dedup": attrib.dedup_expected_bytes(csampler.node_capacity),
+        "gather": attrib.gather_expected_bytes(
+            n_valid / max(len(gouts), 1), dim),
+        "train": train_bytes,
+    }
+    stage_roofline = attrib.stage_roofline_table(
+        stage_ms, stage_bytes, memcpy_roofline_gb_s)
+    _PARTIAL.update({
+        "stage_roofline": stage_roofline,
+        "train_bytes_source": train_bytes_source,
+        **{k: v for k, v in attrib.flat_roofline_fracs(
+            stage_roofline, skip=("gather",)).items()},
+    })
+
     # Tiled-DMA Pallas kernel sweep at its native width (d % 128 == 0):
     # pad the feature rows to 128 columns and sweep the (tile_rows,
     # ring_depth) grid against XLA's gather on real sampled id patterns
@@ -1028,6 +1078,12 @@ def main():
         "obs_noop_ns_per_call": round(obs_noop_ns, 1),
         "serial_step_ms_obs_disabled": round(serial_obs_ms, 2),
         "obs_disabled_overhead_frac": round(obs_overhead_frac, 4),
+        # Per-stage roofline attribution (ISSUE 13): expected-bytes
+        # models over measured stage times; gather_roofline_frac above
+        # stays the headline, the other stages ride beside it.
+        "stage_roofline": stage_roofline,
+        "train_bytes_source": train_bytes_source,
+        **attrib.flat_roofline_fracs(stage_roofline, skip=("gather",)),
     }))
 
 

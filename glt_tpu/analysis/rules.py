@@ -1424,6 +1424,75 @@ class BlockingIOInEpochLoop(Rule):
         return None
 
 
+@register
+class WallClockDuration(Rule):
+    """Durations measured by differencing ``time.time()`` readings.
+
+    ``time.time()`` is the WALL clock: NTP slews/steps it, a VM
+    migration jumps it, and a leap smear stretches it — a duration
+    computed as the difference of two wall readings can come out
+    negative or wildly wrong, and these numbers feed SLO histograms
+    and retry backoffs.  Durations belong on ``time.monotonic()`` /
+    ``time.perf_counter()`` (the convention everywhere in this tree).
+
+    Flagged: a ``-`` expression whose BOTH operands are wall readings —
+    direct ``time.time()`` calls or names/attributes assigned from one
+    in the same scope.  Subtracting a wall reading from a wall-derived
+    *timestamp* (``time.time() - os.path.getmtime(p)``, checkpoint
+    mtimes, event ``ts`` fields) is NOT flagged: comparing two wall
+    timestamps is what the wall clock is for; only a wall-vs-wall
+    *interval* pretends to be a stopwatch.
+    """
+    name = "wall-clock-duration"
+    code = "GLT015"
+    severity = Severity.ERROR
+    description = ("duration computed from two time.time() readings "
+                   "(wall clock steps under NTP/migration; use "
+                   "time.monotonic() or time.perf_counter())")
+
+    _WALL = "time.time"
+
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
+        findings: List[Finding] = []
+        for scope in module.scopes:
+            wall = self._wall_names(module, scope)
+            for node in _walk_own(scope.node):
+                if (isinstance(node, ast.BinOp)
+                        and isinstance(node.op, ast.Sub)
+                        and self._is_wall(module, node.left, wall)
+                        and self._is_wall(module, node.right, wall)):
+                    findings.append(self.finding(
+                        module, node,
+                        f"duration from two time.time() readings in "
+                        f"'{scope.name}' — the wall clock slews and "
+                        f"steps; time a span with time.monotonic() or "
+                        f"time.perf_counter(), or justify with a "
+                        f"suppression"))
+        return findings
+
+    def _wall_names(self, module: ModuleInfo, scope) -> Set[str]:
+        """Names / self-attributes assigned from ``time.time()`` in the
+        scope (the ``t0 = time.time()`` half of the anti-pattern)."""
+        wall: Set[str] = set()
+        for node in _walk_own(scope.node):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = getattr(node, "value", None)
+                if (isinstance(value, ast.Call)
+                        and module.call_name(value) == self._WALL):
+                    wall.update(assign_targets(node))
+        return wall
+
+    def _is_wall(self, module: ModuleInfo, node: ast.expr,
+                 wall: Set[str]) -> bool:
+        if (isinstance(node, ast.Call)
+                and module.call_name(node) == self._WALL):
+            return True
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            d = _dotted(node)
+            return d is not None and d in wall
+        return False
+
+
 def _iter_const_ints(node: ast.expr) -> Iterator[int]:
     if isinstance(node, ast.Constant) and isinstance(node.value, int):
         yield node.value

@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, List, Mapping, NamedTuple, Optional
 
 import numpy as np
 
+from ..obs import flight as _flight
 from ..obs import metrics as _metrics
 from ..obs.trace import span as _span
 from . import state as _state
@@ -96,6 +97,7 @@ class Checkpointer:
             _store.prune(self.root, self.keep)
         _M_SAVES.inc()
         _M_SAVE_MS.observe((time.perf_counter() - t0) * 1e3)
+        _flight.record("ckpt.save", step=int(step), path=str(path))
         return path
 
     def resume(self, components: Mapping[str, Any] = (),
@@ -119,6 +121,7 @@ class Checkpointer:
                     loader(snap.components[name])
         _M_RESUMES.inc()
         _M_RESUME_MS.observe((time.perf_counter() - t0) * 1e3)
+        _flight.record("ckpt.resume", step=int(snap.step))
         return snap
 
     def _read_newest_intact(self, step: Optional[int]) -> Optional[Snapshot]:
@@ -318,5 +321,14 @@ class TrainLoop:
             from ..obs import trace as _trace
 
             _trace.flush_exports(reason=reason.get("reason"))
+            _flight.record("train.supervised_exit",
+                           reason=reason.get("reason"),
+                           step=self.global_step,
+                           checkpoint_path=path)
+            fpath = _flight.dump_now(
+                "supervised_exit:%s" % reason.get("reason"))
+            if fpath:
+                reason = dict(reason)
+                reason["flight_dump"] = fpath
             raise SupervisedExit(reason, step=self.global_step,
                                  checkpoint_path=path) from err

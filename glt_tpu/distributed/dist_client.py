@@ -32,6 +32,7 @@ import numpy as np
 from ..channel.base import QueueSourceDied, bounded_get, bounded_put
 from ..channel.serialization import deserialize
 from ..loader.transform import Batch
+from ..obs import flight as _flight
 from ..obs import metrics as _metrics
 from ..obs import propagate as _prop
 from ..obs.trace import auto_trace, auto_trace_export
@@ -154,6 +155,9 @@ class RemoteServerConnection:
             if getattr(self, "_replacing", False):
                 self.reconnects += 1
                 self._replacing = False
+                _flight.record("remote.reconnect",
+                               addr=list(self._addrs[i]),
+                               reconnects=self.reconnects)
                 tracer = _current_tracer()
                 if tracer is not None:
                     # Tagged with the originating epoch's trace id so a
@@ -337,6 +341,26 @@ class RemoteServerConnection:
             seq = struct.unpack_from("<Q", payload, 0)[0]
             sp.set(seq=int(seq))
             return int(seq), deserialize(payload[8:])
+
+    def flight_dump(self, retries: int = 0) -> Optional[dict]:
+        """Pull the server's flight-recorder ring (``flight_dump`` op).
+
+        Returns the dump object (``glt_flight`` schema,
+        :func:`glt_tpu.obs.flight.validate_flight_dump`), or **None
+        against a pre-flight-recorder server** — an old server answers
+        the unknown op with its fatal error and closes the connection,
+        which this helper degrades to "no black box available"
+        (mixed-version contract; the connection reconnects on next
+        use).  Transport failures degrade the same way: this is a
+        best-effort postmortem read, never a new failure mode.
+        """
+        try:
+            resp = self.request(op="flight_dump", _retries=int(retries))
+        except (RuntimeError, OSError):
+            self._broken = True       # old server closed after the error
+            return None
+        flight = resp.get("flight")
+        return flight if isinstance(flight, dict) else None
 
     @property
     def broken(self) -> bool:

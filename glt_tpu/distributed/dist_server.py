@@ -44,6 +44,7 @@ import numpy as np
 
 from ..channel.base import QueueSourceDied, bounded_get, bounded_put
 from ..channel.serialization import deserialize, serialize
+from ..obs import flight as _flight
 from ..obs import metrics as _metrics
 from ..obs import propagate as _prop
 from ..obs.trace import auto_trace, auto_trace_export
@@ -385,6 +386,8 @@ class _Producer:
         if resend is not None:
             # Sent but never received: resume from the oldest gap.
             _M_REPLAYS.inc()
+            _flight.record("server.replay", msg_seq=resend[0],
+                           epoch=epoch)
             tracer = _current_tracer()
             if tracer is not None:
                 ctx = self._trace_ctx or {}
@@ -537,6 +540,8 @@ class DistServer:
                 prod.stop()
             if expired:
                 _M_REAPED.inc(len(expired))
+                _flight.record("server.producers_reaped",
+                               producer_ids=[pid for pid, _ in expired])
 
     def live_producers(self) -> int:
         with self._lock:
@@ -597,6 +602,8 @@ class DistServer:
                 # or a restart): its previous fleet must not leak.
                 stale.stop()
             _M_CREATED.inc()
+            _flight.record("server.producer_created", producer_id=pid,
+                           num_workers=req.get("num_workers", 0))
             return {"producer_id": pid,
                     "num_expected": prod.num_expected()}
         if op == "heartbeat":
@@ -630,6 +637,23 @@ class DistServer:
             # included — without touching producer state.
             return {"text": self.metrics_text(),
                     "enabled": _metrics.enabled()}
+        if op == "flight_dump":
+            # On-demand black-box read (docs/observability.md "Flight
+            # recorder"): the server's ring of structured events, as the
+            # same JSON object the crash-time dump writes — so an
+            # operator can pull a postmortem from a LIVE server, and
+            # `obs merge` folds it with the clients' dumps.  A pre-13
+            # server answers this op with its usual unknown-op fatal
+            # error; the client helper degrades to None (mixed-version
+            # contract, tests/test_server_client.py).
+            _flight.record("server.flight_dump_served")
+            snap = _flight.recorder().snapshot(reason="wire_op")
+            if req.get("path"):
+                # Optional server-side file dump beside the wire reply
+                # (operator pulling artifacts off the server host).
+                snap["path"] = _flight.dump_now("wire_op",
+                                                path=str(req["path"]))
+            return {"flight": snap}
         if op == "start_new_epoch_sampling":
             self._get_producer(req).start_epoch(
                 int(req.get("epoch", 0)), trace_ctx=trace_ctx)
@@ -777,6 +801,9 @@ class DistServer:
                     # the connection serving — the framed stream is still
                     # in sync.
                     _M_ERRORS.inc()
+                    _flight.record("server.request_error",
+                                   op=str(req.get("op")), code=e.code,
+                                   msg=str(e)[:200])
                     send_frame(conn, _KIND_JSON, json.dumps(
                         {"error": str(e), "code": e.code,
                          **e.extra}).encode())
@@ -785,6 +812,8 @@ class DistServer:
             # retryable (reconnect resyncs framing, the replay window
             # resumes delivery); anything else is a terminal server error.
             code = "protocol" if isinstance(e, ProtocolError) else "fatal"
+            _flight.record("server.conn_error", code=code,
+                           exc=type(e).__name__, msg=str(e)[:200])
             try:
                 send_frame(conn, _KIND_JSON, json.dumps(
                     {"error": str(e), "code": code}).encode())

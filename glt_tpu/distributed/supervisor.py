@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..obs import flight as _flight
 from ..obs import metrics as _metrics
 from ..obs.trace import current as _current_tracer
 
@@ -107,6 +108,7 @@ class _Peer:
     step: Optional[int] = None
     dead: bool = False
     died_after_s: Optional[float] = None
+    beats: int = 0
 
 
 def run_with_deadline(fn: Callable[[], Any], timeout_s: float,
@@ -135,6 +137,8 @@ def run_with_deadline(fn: Callable[[], Any], timeout_s: float,
     t.join(timeout=float(timeout_s))
     if t.is_alive():
         _M_BARRIER_TIMEOUTS.inc()
+        _flight.record("supervisor.barrier_timeout", what=what,
+                       deadline_s=float(timeout_s))
         tracer = _current_tracer()
         if tracer is not None:
             tracer.instant("supervisor.barrier_timeout", what=what,
@@ -212,10 +216,19 @@ class Supervisor:
             peer.last_seen = now
             if step is not None:
                 peer.step = int(step)
+            revived = peer.dead
             # A resurrected peer (restarted process, resumed run) clears
             # its death mark — supervision resumes cleanly.
             peer.dead = False
+            peer.beats += 1
+            beats = peer.beats
         _M_BEATS.inc()
+        # Black-box breadcrumbs, sampled: the first beat, every 32nd
+        # (a trainer beating its loader per batch must not flush the
+        # ring), and any beat that revives a declared-dead peer.
+        if revived or beats == 1 or beats % 32 == 0:
+            _flight.record("supervisor.beat", peer=name, beats=beats,
+                           step=step, revived=revived)
         self._ensure_monitor()
 
     def watch(self, name: str, probe: Callable[[], Any],
@@ -275,6 +288,7 @@ class Supervisor:
                                        peer.deadline_s).report
                 with self._lock:
                     self._dead_reports.append(report)
+                _flight.record("supervisor.peer_dead", **report)
                 tracer = _current_tracer()
                 if tracer is not None:
                     tracer.instant("supervisor.peer_dead", **report)

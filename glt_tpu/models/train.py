@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ..obs import flight as _flight
 from ..obs import metrics as _metrics
 from ..obs.trace import span as _span
 from ..typing import PADDING_ID
@@ -42,6 +43,9 @@ _M_STEPS = _metrics.counter(
     "glt.train.steps", "train steps dispatched by the epoch drivers")
 _M_EPOCHS = _metrics.counter(
     "glt.train.epochs", "scanned epochs driven")
+_M_BLOCK_MS = _metrics.histogram(
+    "glt.train.block_ms",
+    "wall per [G, B] block: dispatch + (when a hook syncs) device wait")
 
 
 class TrainState(NamedTuple):
@@ -343,6 +347,8 @@ def run_scanned_epoch(step, state, train_idx, batch_size: int,
     hooks); it forces the block's device work to finish first, so state
     captured inside the hook is the exact post-block state.
     """
+    import time
+
     import numpy as np
 
     blocks = [jax.device_put(jnp.asarray(b.astype(np.int32)))
@@ -354,9 +360,11 @@ def run_scanned_epoch(step, state, train_idx, batch_size: int,
     losses, accs, ovfs = [], [], []
     with _span("train.scanned_epoch", blocks=len(blocks),
                start_block=int(start_block)):
+        t_epoch0 = time.perf_counter()
         for i, blk in enumerate(blocks):
             if i < start_block:
                 continue
+            t_blk0 = time.perf_counter()
             with _span("train.scanned_block_dispatch"):
                 res = step(state, blk, jax.random.fold_in(base_key, i))
             _M_STEPS.inc()
@@ -376,7 +384,12 @@ def run_scanned_epoch(step, state, train_idx, batch_size: int,
                 # gltlint: disable-next=dispatch-in-epoch-loop
                 jax.block_until_ready(state)
                 on_block(state, i)
+            _M_BLOCK_MS.observe((time.perf_counter() - t_blk0) * 1e3)
         _M_EPOCHS.inc()
+        _flight.record("train.epoch",
+                       blocks=len(blocks) - int(start_block),
+                       start_block=int(start_block),
+                       duration_ms=(time.perf_counter() - t_epoch0) * 1e3)
         # The epoch's own host fetch below is the sync; the span closes
         # around it so the scanned epoch's trace duration is truthful.
         losses = (np.asarray(jax.device_get(

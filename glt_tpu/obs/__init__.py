@@ -24,9 +24,18 @@ never call span()/inc() inside a jit-traced function (gltlint GLT010).
 >>> obs.stop_trace("/tmp/trace.json")
 >>> obs.metrics.snapshot()["glt.loader.batches"]
 """
+from . import attrib  # noqa: F401  (stdlib-only; jax imports are lazy)
+from . import flight  # noqa: F401  (stdlib-only; safe without jax)
 from . import metrics  # noqa: F401  (stdlib-only; safe without jax)
+from . import slo  # noqa: F401  (stdlib-only; safe without jax)
+from .flight import (  # noqa: F401
+    FlightRecorder,
+    merge_flight_dumps,
+    validate_flight_dump,
+)
 from .merge import merge_traces, span_tree_check  # noqa: F401
 from .metrics import prune_unmeasured  # noqa: F401
+from .slo import SloMonitor, SloSpec, default_specs  # noqa: F401
 from .roofline import measure_memcpy_roofline, roofline_fraction  # noqa: F401
 from .summarize import format_summary, summarize_trace  # noqa: F401
 from .trace import (  # noqa: F401
@@ -43,17 +52,26 @@ from .trace import (  # noqa: F401
 )
 
 __all__ = [
+    "FlightRecorder",
+    "SloMonitor",
+    "SloSpec",
     "Span",
     "Tracer",
+    "attrib",
     "auto_trace",
     "auto_trace_export",
     "current",
+    "default_specs",
+    "flight",
     "format_summary",
     "install",
     "measure_memcpy_roofline",
+    "merge_flight_dumps",
     "merge_traces",
     "metrics",
     "prune_unmeasured",
+    "slo",
+    "validate_flight_dump",
     "roofline_fraction",
     "span",
     "span_tree_check",

@@ -1,9 +1,14 @@
-"""CLI: summarize / validate / merge exported traces.
+"""CLI: summarize / validate / merge exported traces + flight dumps.
 
     python -m glt_tpu.obs summarize trace.json [--sort self|total|count]
                                                [--json]
-    python -m glt_tpu.obs validate trace.json
+    python -m glt_tpu.obs validate trace.json|flight.json
     python -m glt_tpu.obs merge -o merged.json client.json server.json ...
+
+``validate`` and ``merge`` auto-detect flight-recorder dumps
+(``glt_flight`` schema marker, obs/flight.py) and route them through
+the flight validator/merger — one postmortem CLI for both artifact
+kinds.
 """
 from __future__ import annotations
 
@@ -11,6 +16,7 @@ import argparse
 import json
 import sys
 
+from .flight import is_flight_dump, merge_flight_dumps, validate_flight_dump
 from .merge import merge_traces
 from .summarize import format_summary, load_trace, summarize_trace
 from .trace import validate_chrome_trace
@@ -50,6 +56,20 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     if args.cmd == "merge":
+        heads = [load_trace(p) for p in args.traces]
+        if any(is_flight_dump(h) for h in heads):
+            if not all(is_flight_dump(h) for h in heads):
+                print("ERROR: cannot merge flight dumps with Chrome "
+                      "traces (merge each kind separately)")
+                return 2
+            merged = merge_flight_dumps(args.traces, args.out)
+            problems = validate_flight_dump(merged)
+            for p in problems:
+                print(f"INVALID: {p}")
+            print(f"{'INVALID' if problems else 'OK'}: merged "
+                  f"{len(args.traces)} flight dumps, "
+                  f"{len(merged['events'])} events -> {args.out}")
+            return 1 if problems else 0
         merged = merge_traces(args.traces, out=args.out,
                               ref_pid=args.ref_pid)
         info = merged["glt"]
@@ -68,6 +88,14 @@ def main(argv=None) -> int:
 
     obj = load_trace(args.trace)
     if args.cmd == "validate":
+        if is_flight_dump(obj):
+            problems = validate_flight_dump(obj)
+            for p in problems:
+                print(f"INVALID: {p}")
+            if not problems:
+                print(f"OK: flight dump, {len(obj['events'])} events, "
+                      f"seq monotonic, reason={obj.get('reason')!r}")
+            return 1 if problems else 0
         problems = validate_chrome_trace(obj)
         for p in problems:
             print(f"INVALID: {p}")

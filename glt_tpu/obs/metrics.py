@@ -212,22 +212,30 @@ class Histogram(_Instrument):
         first bucket); ranks in the +Inf tail return the highest finite
         bucket edge.  NaN on an empty histogram.
         """
-        q = min(max(float(q), 0.0), 1.0)
         with self._lock:
-            count = self._count
             counts = list(self._counts)
-        if count == 0:
-            return float("nan")
-        target = q * count
-        cum = 0.0
-        for i, c in enumerate(counts[:-1]):
-            prev = cum
-            cum += c
-            if cum >= target and c > 0:
-                lo = self.buckets[i - 1] if i > 0 else 0.0
-                hi = self.buckets[i]
-                return lo + (hi - lo) * (target - prev) / c
-        return self.buckets[-1]
+        return quantile_from_counts(self.buckets, counts, q)
+
+
+def quantile_from_counts(buckets: Tuple[float, ...],
+                         counts: List[int], q: float) -> float:
+    """Quantile over raw per-bucket counts (``len(buckets) + 1`` entries,
+    +Inf tail last) — the interpolation :meth:`Histogram.quantile` and
+    the SLO monitor's windowed bucket deltas share.  NaN when empty."""
+    q = min(max(float(q), 0.0), 1.0)
+    count = sum(counts)
+    if count == 0:
+        return float("nan")
+    target = q * count
+    cum = 0.0
+    for i, c in enumerate(counts[:-1]):
+        prev = cum
+        cum += c
+        if cum >= target and c > 0:
+            lo = buckets[i - 1] if i > 0 else 0.0
+            hi = buckets[i]
+            return lo + (hi - lo) * (target - prev) / c
+    return buckets[-1]
 
 
 class Registry:
@@ -342,10 +350,20 @@ def _prom_name(name: str) -> str:
     return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
 
 
+def _escape_label_value(v: str) -> str:
+    # Text exposition format 0.0.4: inside a quoted label value,
+    # backslash, double-quote, and line-feed must be escaped (backslash
+    # FIRST, or the other escapes get double-escaped).
+    return (v.replace("\\", r"\\")
+             .replace('"', r"\"")
+             .replace("\n", r"\n"))
+
+
 def _prom_labels(inst: _Instrument, **extra) -> str:
     items = dict(inst.labels)
     items.update({k: str(v) for k, v in extra.items()})
-    return ",".join(f'{k}="{v}"' for k, v in sorted(items.items()))
+    return ",".join(f'{k}="{_escape_label_value(str(v))}"'
+                    for k, v in sorted(items.items()))
 
 
 def _prom_label_suffix(inst: _Instrument) -> str:

@@ -58,6 +58,12 @@ EXPLICIT_DIRECTIONS: Dict[str, int] = {
     "cache_hit_rate_cold": UP,
     "est_hbm_fraction": UP,
     "gather_roofline_frac": UP,
+    # Per-stage attribution (ISSUE 13, glt_tpu/obs/attrib.py): every
+    # stage's achieved fraction of the memcpy ceiling tracks UP — a
+    # drop means that stage got further from the machine.
+    "sample_roofline_frac": UP,
+    "dedup_roofline_frac": UP,
+    "train_roofline_frac": UP,
     "obs_disabled_overhead_frac": DOWN,
     "sampling_overhead_frac": DOWN,
     "sampling_overhead_frac_epoch": DOWN,

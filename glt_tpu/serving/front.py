@@ -39,6 +39,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..obs import flight as _flight
 from ..obs import metrics as _metrics
 from ..obs.trace import span as _span
 from .engine import SubgraphEngine
@@ -77,6 +78,9 @@ _H_SCATTER = _metrics.histogram(
     "micro-batch host stage: per-request split/relabel")
 _H_E2E = _metrics.histogram(
     "glt.serving.e2e_ms", "submit -> completion per request, server-side")
+_M_SHED = _metrics.counter(
+    "glt.serving.rejected_shed",
+    "requests rejected early while an SLO burn alert sheds load")
 
 
 class _Pending:
@@ -126,6 +130,12 @@ class ServingFront:
         self._failed = 0
         self._rejected_overload = 0
         self._rejected_deadline = 0
+        self._rejected_shed = 0
+        # SLO shed-load seam (obs/slo.py): while a burn alert is firing
+        # the admission bound shrinks to (1 - shed_frac) of the queue, so
+        # the backlog drains instead of feeding the burn.  0.0 = open.
+        self._shed_frac = 0.0
+        self._shed_slo: Optional[str] = None
         # EWMA of micro-batch service time, seeding the retry-after hint
         # before the first batch lands (compile-heavy) with the wait knob.
         self._ewma_batch_ms = max(10.0, 2.0 * float(options.max_wait_ms))
@@ -148,18 +158,52 @@ class ServingFront:
         deadline = (None if deadline_ms is None or deadline_ms <= 0
                     else time.monotonic() + float(deadline_ms) / 1e3)
         pending = _Pending(canonical, deadline)
+        shed = self._shed_frac
+        if shed > 0.0:
+            # Burn alert active: admit only into the un-shed fraction of
+            # the queue so the backlog that is burning the SLO drains.
+            bound = max(1, int(self._queue.maxsize * (1.0 - shed)))
+            if self._queue.qsize() >= bound:
+                with self._stats_lock:
+                    self._rejected_shed += 1
+                _M_SHED.inc()
+                _flight.record("serving.rejected_shed",
+                               slo=self._shed_slo, shed_frac=shed,
+                               inflight=self._queue.qsize())
+                raise Overloaded(
+                    f"shedding load ({self._shed_slo} SLO burning, "
+                    f"shed_frac={shed:g}); retry after the hint",
+                    retry_after_ms=self.retry_after_ms()) from None
         try:
             self._queue.put_nowait(pending)
         except queue.Full:
             with self._stats_lock:
                 self._rejected_overload += 1
             _M_OVERLOAD.inc()
+            _flight.record("serving.rejected_overload",
+                           inflight=self._queue.maxsize,
+                           retry_after_ms=self.retry_after_ms())
             raise Overloaded(
                 f"serving queue full ({self.options.max_inflight} "
                 f"inflight); retry after the hint",
                 retry_after_ms=self.retry_after_ms()) from None
         _M_REQUESTS.inc()
         return pending
+
+    def slo_alert(self, alert: dict) -> None:
+        """``on_alert`` seam for :class:`~glt_tpu.obs.slo.SloMonitor`:
+        a firing burn alert shrinks admission by the alert's
+        ``shed_frac``; the resolve transition re-opens it.  Safe from
+        the monitor's sampling thread (single attribute writes)."""
+        if alert.get("state") == "firing":
+            self._shed_frac = float(alert.get("shed_frac") or 0.5)
+            self._shed_slo = alert.get("slo")
+            _flight.record("serving.shed_on", slo=self._shed_slo,
+                           shed_frac=self._shed_frac)
+        else:
+            _flight.record("serving.shed_off", slo=alert.get("slo"))
+            self._shed_frac = 0.0
+            self._shed_slo = None
 
     def retry_after_ms(self) -> float:
         """Backoff hint: how long until a queue slot should open —
@@ -289,6 +333,9 @@ class ServingFront:
                 "failed": self._failed,
                 "rejected_overload": self._rejected_overload,
                 "rejected_deadline": self._rejected_deadline,
+                "rejected_shed": self._rejected_shed,
+                "shed_frac": self._shed_frac,
+                "shed_slo": self._shed_slo,
                 "ewma_batch_ms": round(self._ewma_batch_ms, 3),
                 "compiled_buckets": self.engine.compiled_buckets(),
             }

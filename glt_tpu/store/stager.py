@@ -44,6 +44,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs import flight as _flight
 from ..obs import metrics as _metrics
 from .disk import DiskFeatureStore
 
@@ -132,6 +133,8 @@ class DramStager:
                     self._score[: self._used],
                     n_evict - 1)[:n_evict].astype(np.int64)
                 self._slot_of[self._row_of[victims]] = -1
+                _flight.record("store.evict", count=int(n_evict),
+                               resident=int(self._used))
             slots = np.arange(self._used, self._used + take, dtype=np.int64)
             self._used += take
             if victims is not None:

@@ -1,0 +1,587 @@
+"""ISSUE 13: flight recorder, SLO burn-rate monitor, stage attribution.
+
+Layered like the subsystem: ring/dump/merge unit tests (stdlib only),
+crash-time dumps in real subprocesses (SIGTERM, uncaught exception —
+with NO arming beyond using the library), the SLO monitor driven
+deterministically with injected clocks (synthetic overload fires, a
+clean run stays silent, the short window auto-resolves), the serving
+front's shed-load seam, the expected-bytes attribution models, and the
+chaos postmortem: a dead peer takes the training loop down through
+SupervisedExit and the exception carries a validated flight dump whose
+last events include the fatal one.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from glt_tpu.obs import attrib, flight, metrics
+from glt_tpu.obs.flight import (
+    FlightRecorder,
+    is_flight_dump,
+    merge_flight_dumps,
+    validate_flight_dump,
+)
+from glt_tpu.obs.slo import (
+    SloMonitor,
+    SloSpec,
+    default_specs,
+    spec_from_dict,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    flight.recorder().clear()
+    yield
+    flight.recorder().clear()
+
+
+# ---------------------------------------------------------------------------
+# ring buffer + dump + merge
+# ---------------------------------------------------------------------------
+
+class TestRing:
+    def test_wraps_and_counts(self):
+        r = FlightRecorder(capacity=8, role="t")
+        for i in range(12):
+            r.record("tick", i=i)
+        assert r.recorded == 12
+        assert r.dropped == 4
+        evs = r.events()
+        assert len(evs) == 8
+        assert [e["seq"] for e in evs] == list(range(4, 12))
+        assert all(e["kind"] == "tick" and "ts" in e for e in evs)
+
+    def test_capacity_floor(self):
+        assert FlightRecorder(capacity=1).capacity == 8
+
+    def test_snapshot_schema(self):
+        r = FlightRecorder(capacity=16, role="server")
+        r.record("a", x=1)
+        snap = r.snapshot(reason="unit")
+        assert is_flight_dump(snap)
+        assert validate_flight_dump(snap) == []
+        assert snap["role"] == "server" and snap["reason"] == "unit"
+        assert snap["pid"] == os.getpid()
+        assert snap["events"][0]["x"] == 1
+
+    def test_dump_is_atomic(self, tmp_path):
+        r = FlightRecorder(capacity=16, role="t")
+        r.record("a")
+        path = str(tmp_path / "f.json")
+        assert r.dump(path, reason="unit") == path
+        r.record("b")
+        r.dump(path, reason="unit2")      # overwrite in place
+        doc = json.load(open(path))
+        assert validate_flight_dump(doc) == []
+        assert doc["reason"] == "unit2" and len(doc["events"]) == 2
+        leftovers = [p for p in os.listdir(tmp_path)
+                     if p.startswith("f.json.tmp")]
+        assert leftovers == []            # GLT011: no torn/temp files
+
+    def test_validate_catches_tampering(self):
+        snap = FlightRecorder(capacity=8).snapshot()
+        snap["events"] = [{"seq": 3, "ts": 1.0, "kind": "a"},
+                          {"seq": 2, "ts": 2.0, "kind": "b"}]
+        snap["recorded"] = 10              # 10 recorded, 2 kept, 0 dropped?
+        probs = validate_flight_dump(snap)
+        assert any("not after" in p for p in probs)
+        assert any("inconsistent" in p for p in probs)
+        assert validate_flight_dump({"nope": 1})[0].startswith(
+            "not a flight dump")
+        missing = {flight.SCHEMA_KEY: 1}
+        assert any("missing field" in p
+                   for p in validate_flight_dump(missing))
+
+    def test_record_never_raises(self):
+        r = FlightRecorder(capacity=8)
+        r.record("weird", obj=object())   # non-JSON field still records
+        assert r.recorded == 1
+
+    def test_fields_cannot_shadow_envelope(self):
+        # Regression: server.replay passed its MESSAGE seq as a field,
+        # clobbering the ring seq and breaking the dump's ordering
+        # proof.  Envelope wins; the payload survives under x_.
+        r = FlightRecorder(capacity=8)
+        r.record("a")
+        r.record("replay", seq=0, ts=-1.0, kind="evil", epoch=3)
+        ev = r.events()[1]
+        assert ev["seq"] == 1 and ev["kind"] == "replay"
+        assert ev["ts"] > 0
+        assert (ev["x_seq"], ev["x_ts"], ev["x_kind"]) == (0, -1.0, "evil")
+        assert ev["epoch"] == 3
+        assert flight.validate_flight_dump(r.snapshot()) == []
+
+    def test_configure_preserves_tail(self):
+        rec = flight.recorder()
+        old_cap = rec.capacity
+        try:
+            for i in range(6):
+                flight.record("k", i=i)
+            flight.configure(capacity=max(8, old_cap // 2), role="resized")
+            evs = flight.recorder().events()
+            assert [e["i"] for e in evs[-6:]] == list(range(6))
+            assert flight.recorder().role == "resized"
+        finally:
+            flight.configure(capacity=old_cap, role="proc")
+
+    def test_merge_orders_and_tags(self, tmp_path):
+        a = FlightRecorder(capacity=8, role="client")
+        b = FlightRecorder(capacity=8, role="server")
+        a.record("c1")
+        b.record("s1")
+        a.record("c2")
+        pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        a.dump(pa, reason="t")
+        b.dump(pb, reason="t")
+        out = str(tmp_path / "m.json")
+        merged = merge_flight_dumps([pa, pb], out)
+        assert validate_flight_dump(merged) == []
+        assert os.path.isfile(out)
+        roles = {e["role"] for e in merged["events"]}
+        assert roles == {"client", "server"}
+        ts = [e["ts"] for e in merged["events"]]
+        assert ts == sorted(ts)
+        assert len(merged["sources"]) == 2
+
+    def test_merge_rejects_invalid(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"not": "a dump"}))
+        with pytest.raises(ValueError, match="not a flight dump"):
+            merge_flight_dumps([str(bad)])
+        with pytest.raises(ValueError, match="no flight dumps"):
+            merge_flight_dumps([])
+
+    def test_cli_validate_and_merge_route_flight(self, tmp_path, capsys):
+        from glt_tpu.obs.__main__ import main
+
+        r = FlightRecorder(capacity=8, role="w")
+        r.record("e")
+        p = str(tmp_path / "f.json")
+        r.dump(p, reason="cli")
+        r2 = FlightRecorder(capacity=8, role="w2")
+        r2.record("e2")
+        p2 = str(tmp_path / "f2.json")
+        r2.dump(p2, reason="cli")
+        assert main(["validate", p]) == 0
+        assert "flight dump" in capsys.readouterr().out
+        out = str(tmp_path / "m.json")
+        assert main(["merge", "-o", out, p, p2]) == 0
+        assert "flight dumps" in capsys.readouterr().out
+        assert validate_flight_dump(json.load(open(out))) == []
+
+    def test_cli_refuses_mixed_kinds(self, tmp_path, capsys):
+        from glt_tpu.obs.__main__ import main
+
+        r = FlightRecorder(capacity=8)
+        r.record("e")
+        fp = str(tmp_path / "f.json")
+        r.dump(fp)
+        tp = tmp_path / "t.json"
+        tp.write_text(json.dumps({"traceEvents": []}))
+        rc = main(["merge", "-o", str(tmp_path / "m.json"), fp, str(tp)])
+        assert rc == 2
+        assert "cannot merge" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# crash-time dumps: real subprocesses, zero arming
+# ---------------------------------------------------------------------------
+
+class TestCrashDump:
+    def test_sigterm_dumps_then_dies_with_term(self, tmp_path):
+        """A SIGTERMed process leaves its black box behind AND still
+        dies with signal-death status (the supervisor must see the
+        kill).  The only setup is using the library — recording one
+        event self-installs the handlers."""
+        script = (
+            "import sys, time\n"
+            "sys.path.insert(0, %r)\n"
+            "from glt_tpu.obs import flight\n"
+            "flight.configure(role='victim')\n"
+            "flight.record('epoch.start', epoch=3)\n"
+            "print('READY', flush=True)\n"
+            "time.sleep(30)\n" % REPO_ROOT
+        )
+        env = {**os.environ, "GLT_FLIGHT_DIR": str(tmp_path)}
+        proc = subprocess.Popen([sys.executable, "-c", script], env=env,
+                                stdout=subprocess.PIPE, text=True)
+        try:
+            assert proc.stdout.readline().strip() == "READY"
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert rc == -signal.SIGTERM
+        files = [p for p in os.listdir(tmp_path)
+                 if p.startswith("glt_flight-victim-")]
+        assert len(files) == 1
+        doc = json.load(open(os.path.join(str(tmp_path), files[0])))
+        assert validate_flight_dump(doc) == []
+        assert doc["reason"] == "sigterm"
+        kinds = [e["kind"] for e in doc["events"]]
+        assert kinds[0] == "epoch.start"
+        assert "process.sigterm" in kinds
+
+    def test_uncaught_exception_dumps(self, tmp_path):
+        """An uncaught exception leaves a dump tagged with the
+        exception type — with NO environment arming at all (the dump
+        lands at the default tempdir path)."""
+        script = (
+            "import sys\n"
+            "sys.path.insert(0, %r)\n"
+            "from glt_tpu.obs import flight\n"
+            "flight.configure(role='crasher')\n"
+            "flight.record('step', n=7)\n"
+            "print(flight.recorder().default_path(), flush=True)\n"
+            "raise RuntimeError('boom')\n" % REPO_ROOT
+        )
+        env = {k: v for k, v in os.environ.items()
+               if k != "GLT_FLIGHT_DIR"}
+        env["TMPDIR"] = str(tmp_path)
+        proc = subprocess.Popen([sys.executable, "-c", script], env=env,
+                                stdout=subprocess.PIPE, text=True)
+        dump_path = proc.stdout.readline().strip()
+        rc = proc.wait(timeout=30)
+        assert rc == 1
+        assert os.path.isfile(dump_path)
+        doc = json.load(open(dump_path))
+        assert validate_flight_dump(doc) == []
+        assert doc["reason"] == "uncaught:RuntimeError"
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "step" in kinds and "process.uncaught" in kinds
+        fatal = [e for e in doc["events"]
+                 if e["kind"] == "process.uncaught"][0]
+        assert fatal["exc"] == "RuntimeError" and "boom" in fatal["msg"]
+
+
+# ---------------------------------------------------------------------------
+# SLO monitor: burn-rate windows, alerts, flight + callback outputs
+# ---------------------------------------------------------------------------
+
+class TestSloSpec:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="unknown SLO kind"):
+            SloSpec(name="x", metric="m", objective=1.0, kind="nope")
+        with pytest.raises(ValueError, match="needs denom"):
+            SloSpec(name="x", metric="m", objective=1.0, kind="ratio")
+        with pytest.raises(ValueError, match="comparison"):
+            SloSpec(name="x", metric="m", objective=1.0, comparison="<")
+        with pytest.raises(ValueError, match="objective"):
+            SloSpec(name="x", metric="m", objective=0.0)
+        with pytest.raises(ValueError, match="windows"):
+            SloSpec(name="x", metric="m", objective=1.0, windows=())
+
+    def test_from_dict(self):
+        s = spec_from_dict({"name": "p99", "metric": "glt.serving.e2e_ms",
+                            "objective": 50.0, "q": 0.99,
+                            "windows": [[30, 1.0], [5, 1.0]]})
+        assert s.windows == ((30.0, 1.0), (5.0, 1.0))
+        assert s.kind == "quantile" and s.comparison == "<="
+
+    def test_default_specs_cover_the_fleet(self):
+        names = {s.name for s in default_specs()}
+        assert names == {"serving_p99", "serving_rejects", "train_step",
+                         "store_hit_rate"}
+        metrics_used = {s.metric for s in default_specs()}
+        assert "glt.train.block_ms" in metrics_used
+        assert "glt.store.hit_rate" in metrics_used
+
+
+class TestSloMonitor:
+    def test_overload_fires_then_short_window_resolves(self):
+        """Synthetic overload: a p99 far over objective fires once ALL
+        windows burn; when the burn stops, the SHORT window resolves
+        the alert while the long one still remembers the damage."""
+        metrics.enable()
+        h = metrics.histogram("glt.slo_t.e2e_ms",
+                              buckets=(1.0, 10.0, 100.0))
+        spec = SloSpec(name="p99", metric="glt.slo_t.e2e_ms",
+                       objective=10.0, q=0.99,
+                       windows=((30.0, 1.0), (5.0, 1.0)))
+        seen = []
+        mon = SloMonitor([spec], on_alert=seen.append)
+        assert mon.tick(now=0.0) == []            # no history yet
+        for _ in range(20):
+            h.observe(50.0)                        # 5x the objective
+        fired = mon.tick(now=40.0)
+        assert len(fired) == 1
+        assert fired[0]["state"] == "firing"
+        assert fired[0]["slo"] == "p99"
+        assert fired[0]["shed_frac"] == 0.5
+        assert all(b > 1.0 for b in fired[0]["burn"].values())
+        assert mon.firing() == ["p99"]
+        assert seen == fired
+        # Steady firing emits nothing new.
+        for _ in range(5):
+            h.observe(50.0)
+        assert mon.tick(now=43.0) == []
+        # Burn stops: the 5 s window goes quiet -> resolved transition.
+        resolved = mon.tick(now=49.0)
+        assert len(resolved) == 1
+        assert resolved[0]["state"] == "resolved"
+        assert resolved[0]["shed_frac"] == 0.0
+        assert mon.firing() == []
+        # Alerts landed in the flight recorder + the slo instruments.
+        kinds = [e["kind"] for e in flight.recorder().events()]
+        assert kinds.count("slo.alert") == 2
+        snap = metrics.snapshot()
+        assert snap["glt.slo.alerts"] >= 1.0
+        assert snap["glt.slo.firing{slo=p99}"] == 0.0
+
+    def test_clean_run_is_silent(self):
+        metrics.enable()
+        h = metrics.histogram("glt.slo_t.clean_ms",
+                              buckets=(1.0, 10.0, 100.0))
+        spec = SloSpec(name="clean", metric="glt.slo_t.clean_ms",
+                       objective=10.0, q=0.99)
+        mon = SloMonitor([spec])
+        mon.tick(now=0.0)
+        for _ in range(50):
+            h.observe(2.0)                         # well under objective
+        assert mon.tick(now=40.0) == []
+        assert mon.tick(now=46.0) == []
+        assert mon.firing() == []
+        assert [e for e in flight.recorder().events()
+                if e["kind"] == "slo.alert"] == []
+
+    def test_ratio_spec(self):
+        metrics.enable()
+        bad = metrics.counter("glt.slo_t.rejected")
+        good = metrics.counter("glt.slo_t.accepted")
+        spec = SloSpec(name="rejects", metric="glt.slo_t.rejected",
+                       denom="glt.slo_t.accepted", kind="ratio",
+                       objective=0.10,
+                       windows=((30.0, 1.0), (5.0, 1.0)))
+        mon = SloMonitor([spec])
+        mon.tick(now=0.0)
+        bad.inc(50)
+        good.inc(50)                               # 50% rejected >> 10%
+        fired = mon.tick(now=40.0)
+        assert len(fired) == 1 and fired[0]["state"] == "firing"
+        v = fired[0]["value"]["30s"]
+        assert v == pytest.approx(0.5)
+
+    def test_gauge_spec_fires_below_objective(self):
+        metrics.enable()
+        g = metrics.gauge("glt.slo_t.hit_rate")
+        g.set(0.1)                                 # objective >= 0.5
+        spec = SloSpec(name="hits", metric="glt.slo_t.hit_rate",
+                       kind="gauge", objective=0.5, comparison=">=")
+        mon = SloMonitor([spec])
+        fired = mon.tick(now=0.0)
+        assert len(fired) == 1 and fired[0]["state"] == "firing"
+        g.set(0.9)                                 # healthy again
+        resolved = mon.tick(now=1.0)
+        assert resolved[0]["state"] == "resolved"
+
+    def test_absent_instrument_never_fires(self):
+        spec = SloSpec(name="ghost", metric="glt.slo_t.does_not_exist",
+                       objective=1.0)
+        mon = SloMonitor([spec])
+        assert mon.tick(now=0.0) == []
+        assert mon.tick(now=60.0) == []
+
+    def test_on_alert_exception_is_swallowed(self):
+        metrics.enable()
+        g = metrics.gauge("glt.slo_t.g2")
+        g.set(0.0)
+        spec = SloSpec(name="g2", metric="glt.slo_t.g2", kind="gauge",
+                       objective=0.5, comparison=">=")
+
+        def explode(alert):
+            raise RuntimeError("callback bug")
+
+        mon = SloMonitor([spec], on_alert=explode)
+        fired = mon.tick(now=0.0)                  # must not raise
+        assert fired[0]["state"] == "firing"
+
+    def test_metric_delta_events_bounded(self):
+        metrics.enable()
+        c = metrics.counter("glt.slo_t.deltas")
+        mon = SloMonitor([], delta_interval_s=5.0)
+        mon.tick(now=0.0)                          # baseline snapshot
+        c.inc(42)
+        mon.tick(now=10.0)
+        deltas = [e for e in flight.recorder().events()
+                  if e["kind"] == "metrics.delta"]
+        assert len(deltas) == 1
+        assert deltas[0]["deltas"]["glt.slo_t.deltas"] == 42.0
+        assert len(deltas[0]["deltas"]) <= 12
+
+    def test_sampling_thread_lifecycle(self):
+        metrics.enable()
+        before = metrics.snapshot().get("glt.slo.ticks", 0.0)
+        mon = SloMonitor([], interval_s=0.01).start()
+        time.sleep(0.1)
+        mon.stop()
+        assert metrics.snapshot()["glt.slo.ticks"] > before
+
+    def test_states_table(self):
+        metrics.enable()
+        g = metrics.gauge("glt.slo_t.g3")
+        g.set(1.0)
+        spec = SloSpec(name="g3", metric="glt.slo_t.g3", kind="gauge",
+                       objective=0.5, comparison=">=")
+        mon = SloMonitor([spec])
+        mon.tick(now=0.0)
+        st = mon.states()["g3"]
+        assert st["firing"] is False
+        assert all(b is not None and b <= 1.0 for b in st["burn"].values())
+
+
+# ---------------------------------------------------------------------------
+# serving front: shed-load seam
+# ---------------------------------------------------------------------------
+
+class TestServingShed:
+    def test_firing_alert_sheds_then_resolve_reopens(self):
+        from tests.test_serving import FakeEngine, make_front
+
+        front = make_front(FakeEngine(delay=0.5), max_inflight=4,
+                           max_wait_ms=1.0)
+        try:
+            front.submit([0])                  # dispatcher holds this one
+            time.sleep(0.05)
+            front.slo_alert({"slo": "p99", "state": "firing",
+                             "shed_frac": 0.5})
+            assert front.stats()["shed_frac"] == 0.5
+            assert front.stats()["shed_slo"] == "p99"
+            front.submit([1])
+            front.submit([2])                  # at the shed bound (2 of 4)
+            from glt_tpu.serving import Overloaded
+
+            with pytest.raises(Overloaded, match="shedding load"):
+                front.submit([3])
+            assert front.stats()["rejected_shed"] == 1
+            front.slo_alert({"slo": "p99", "state": "resolved",
+                             "shed_frac": 0.0})
+            assert front.stats()["shed_frac"] == 0.0
+            front.submit([3])                  # full queue available again
+            kinds = [e["kind"] for e in flight.recorder().events()]
+            assert "serving.shed_on" in kinds
+            assert "serving.rejected_shed" in kinds
+            assert "serving.shed_off" in kinds
+        finally:
+            front.stop()
+
+    def test_overload_rejection_records_flight_event(self):
+        from tests.test_serving import FakeEngine, make_front
+        from glt_tpu.serving import Overloaded
+
+        front = make_front(FakeEngine(delay=0.3), max_inflight=1)
+        try:
+            front.submit([0])
+            time.sleep(0.05)
+            front.submit([1])
+            with pytest.raises(Overloaded):
+                front.submit([2])
+            kinds = [e["kind"] for e in flight.recorder().events()]
+            assert "serving.rejected_overload" in kinds
+        finally:
+            front.stop()
+
+
+# ---------------------------------------------------------------------------
+# expected-bytes attribution models
+# ---------------------------------------------------------------------------
+
+class TestAttrib:
+    def test_sample_expected_bytes_hand_computed(self):
+        # batch 2, one hop of fanout 2, 4-byte ids:
+        # seeds 2*4 + indptr 2*2*4 + neighbor reads 2*2*4 + outputs
+        # 2*2*2*4 = 8 + 16 + 16 + 32 = 72
+        assert attrib.sample_expected_bytes(2, (2,)) == 72
+        # frontier multiplies: a second hop adds 4*2*4 + 4*2*4 + 4*2*2*4
+        assert attrib.sample_expected_bytes(2, (2, 2)) == 72 + 128
+
+    def test_dedup_and_gather_bytes(self):
+        assert attrib.dedup_expected_bytes(10) == 160
+        assert attrib.gather_expected_bytes(100, 128) == 100 * 128 * 4
+        assert attrib.train_expected_bytes(1000, 200) == 5400
+
+    def test_param_nbytes(self):
+        import jax.numpy as jnp
+
+        params = {"w": jnp.zeros((4, 4), jnp.float32),
+                  "b": jnp.zeros((4,), jnp.bfloat16)}
+        assert attrib.param_nbytes(params) == 4 * 4 * 4 + 4 * 2
+
+    def test_compiled_cost_bytes_never_raises(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x * 2.0)
+        got = attrib.compiled_cost_bytes(f, jnp.ones((128,)))
+        assert got is None or got > 0
+        assert attrib.compiled_cost_bytes(lambda x: x, 1) is None
+
+    def test_stage_roofline_table(self):
+        tbl = attrib.stage_roofline_table(
+            stage_ms={"gather": 2.0, "train": 4.0, "sample": None},
+            stage_bytes={"gather": 2e6, "train": 8e6},
+            memcpy_gb_s=10.0)
+        assert set(tbl) == {"gather", "train"}   # unmeasured omitted
+        assert tbl["gather"]["gb_s"] == pytest.approx(1.0)
+        assert tbl["gather"]["roofline_frac"] == pytest.approx(0.1)
+        assert tbl["train"]["roofline_frac"] == pytest.approx(0.2)
+        flat = attrib.flat_roofline_fracs(tbl, skip=("gather",))
+        assert flat == {"train_roofline_frac": pytest.approx(0.2)}
+
+    def test_zero_ceiling_is_safe(self):
+        tbl = attrib.stage_roofline_table(
+            {"gather": 1.0}, {"gather": 1e6}, memcpy_gb_s=0.0)
+        assert tbl["gather"]["roofline_frac"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# chaos postmortem: dead peer -> SupervisedExit carries a flight dump
+# ---------------------------------------------------------------------------
+
+def test_chaos_postmortem_supervised_exit_carries_flight_dump(tmp_path):
+    """End-to-end black box: a peer dies mid-training, the supervisor
+    detects the silence, the loop publishes its emergency checkpoint and
+    raises SupervisedExit — and the exception's report points at a
+    validated flight dump whose last events include the supervisor's
+    peer-death verdict AND the fatal supervised-exit event.  Nothing was
+    armed: no env vars, no enable calls — the recorder is always on."""
+    from glt_tpu.ckpt import Checkpointer
+    from glt_tpu.distributed.supervisor import SupervisedExit, Supervisor
+    from tests.test_checkpoint import _make_loop
+
+    sup = Supervisor(deadline_secs=0.15, poll_interval=0.05)
+    sup.register("producer-7")            # never beats: dead after 0.15 s
+    loop = _make_loop(Checkpointer(str(tmp_path), every_n_steps=1),
+                      supervisor=sup)
+    time.sleep(0.5)                       # let the deadline expire
+    with pytest.raises(SupervisedExit) as err:
+        loop.run()
+    sup.stop()
+    report = err.value.report
+    assert report["reason"] == "peer_dead"
+    fpath = report.get("flight_dump")
+    assert fpath and os.path.isfile(fpath)
+    try:
+        doc = json.load(open(fpath))
+        assert validate_flight_dump(doc) == []
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "supervisor.peer_dead" in kinds
+        assert kinds[-1] == "train.supervised_exit"
+        dead = [e for e in doc["events"]
+                if e["kind"] == "supervisor.peer_dead"][0]
+        assert dead["peer"] == "producer-7"
+        fatal = [e for e in doc["events"]
+                 if e["kind"] == "train.supervised_exit"][0]
+        assert fatal["reason"] == "peer_dead"
+        assert fatal["checkpoint_path"] == err.value.checkpoint_path
+    finally:
+        os.remove(fpath)

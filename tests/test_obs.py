@@ -253,6 +253,23 @@ class TestMetrics:
         assert 'glt_t_ms_bucket{le="+Inf"} 1' in text
         assert "glt_t_ms_count 1" in text
 
+    def test_prometheus_escapes_hostile_label_values(self):
+        """Label values containing quotes, backslashes, and newlines
+        must not corrupt the exposition (ISSUE 13 satellite: format
+        0.0.4 escaping — backslash first, then quote, then LF)."""
+        metrics.enable()
+        metrics.counter("glt.t.hostile", "h", labels={
+            "path": 'C:\\tmp\\"x"\nEOL'}).inc(2)
+        text = metrics.render_prometheus()
+        line = [ln for ln in text.splitlines()
+                if ln.startswith("glt_t_hostile_total{")][0]
+        assert line == ('glt_t_hostile_total'
+                        '{path="C:\\\\tmp\\\\\\"x\\"\\nEOL"} 2.0')
+        # The exposition stays line-structured: no raw newline leaked
+        # out of the label value into the body.
+        for ln in text.splitlines():
+            assert ln == "" or ln.startswith("#") or " " in ln
+
     def test_prune_unmeasured(self):
         out = obs.prune_unmeasured(
             {"a": 1.0, "overflow_rate": None, "b": -1.0})
@@ -310,6 +327,52 @@ class TestHistogramQuantiles:
         metrics.enable()
         h = metrics.histogram("glt.t.q3_ms")
         assert np.isnan(h.quantile(0.5))
+
+    def test_quantile_single_observation(self):
+        """One sample: every q resolves inside its bucket with no
+        divide-by-zero (ISSUE 13 satellite)."""
+        metrics.enable()
+        h = metrics.histogram("glt.t.q4_ms", buckets=(1.0, 2.0, 4.0))
+        h.observe(3.0)                      # alone in (2, 4]
+        assert 2.0 <= h.quantile(0.0) <= 4.0
+        assert 2.0 <= h.quantile(0.5) <= 4.0
+        assert h.quantile(1.0) == pytest.approx(4.0)
+
+    def test_quantile_extreme_q_clamped(self):
+        metrics.enable()
+        h = metrics.histogram("glt.t.q5_ms", buckets=(1.0, 2.0))
+        for v in (0.5, 1.5):
+            h.observe(v)
+        # out-of-range q clamps instead of indexing off the ends
+        assert h.quantile(-0.5) == h.quantile(0.0)
+        assert h.quantile(1.5) == h.quantile(1.0)
+        assert h.quantile(0.0) <= h.quantile(1.0)
+
+    def test_quantile_all_in_one_bucket(self):
+        """Every sample in a single bucket: the interpolation never
+        divides by an empty preceding bucket's zero count."""
+        metrics.enable()
+        h = metrics.histogram("glt.t.q6_ms", buckets=(1.0, 10.0, 100.0))
+        for _ in range(7):
+            h.observe(5.0)                  # all in (1, 10]
+        for q in (0.0, 0.01, 0.5, 0.99, 1.0):
+            v = h.quantile(q)
+            assert 1.0 <= v <= 10.0, (q, v)
+
+    def test_quantile_from_counts_module_function(self):
+        """The extracted interpolation the SLO monitor feeds windowed
+        bucket deltas through (glt_tpu/obs/slo.py)."""
+        from glt_tpu.obs.metrics import quantile_from_counts
+
+        buckets = (1.0, 2.0, 4.0)          # finite edges; counts carry
+        assert np.isnan(                    # the +Inf tail as entry 4
+            quantile_from_counts(buckets, [0, 0, 0, 0], 0.5))
+        # 4 in (1, 2] -> median at the bucket midpoint
+        assert quantile_from_counts(buckets, [0, 4, 0, 0], 0.5) \
+            == pytest.approx(1.5)
+        # +Inf tail clamps to the highest finite edge
+        assert quantile_from_counts(buckets, [0, 0, 0, 3], 0.99) \
+            == pytest.approx(4.0)
 
     def test_snapshot_reports_percentiles(self):
         metrics.enable()

@@ -522,6 +522,88 @@ def test_new_traced_client_against_old_server():
         t.join(timeout=10)
 
 
+def test_flight_dump_wire_op(server, tmp_path):
+    """ISSUE 13: ``flight_dump`` pulls the server's black box over the
+    wire — the same ``glt_flight`` object the crash-time dump writes,
+    so ``obs merge`` folds it with client-side dumps."""
+    from glt_tpu.distributed.dist_client import RemoteServerConnection
+    from glt_tpu.obs.flight import is_flight_dump, validate_flight_dump
+
+    conn = RemoteServerConnection(server.addr)
+    try:
+        snap = conn.flight_dump()
+        assert is_flight_dump(snap)
+        assert validate_flight_dump(snap) == []
+        assert snap["reason"] == "wire_op"
+        kinds = [e["kind"] for e in snap["events"]]
+        assert "server.flight_dump_served" in kinds
+        # Optional server-side artifact beside the wire reply.
+        p = tmp_path / "srv_flight.json"
+        resp = conn.request(op="flight_dump", path=str(p))
+        assert resp["flight"]["path"] == str(p)
+        with open(p) as f:
+            assert validate_flight_dump(json.load(f)) == []
+    finally:
+        conn.close()
+
+
+def test_old_client_flight_dump_against_new_server(server):
+    """Mixed-version (ISSUE 13 satellite): a pre-13 client never sends
+    the op, but an operator's plain-JSON poke — no #trace, no helper —
+    must get the dump back as ordinary JSON: nothing about the black
+    box requires a new client."""
+    from glt_tpu.distributed.dist_server import (_KIND_JSON, recv_frame,
+                                                 send_frame)
+    from glt_tpu.obs.flight import is_flight_dump
+
+    raw = socket.create_connection(server.addr, timeout=10)
+    raw.settimeout(10)
+    try:
+        send_frame(raw, _KIND_JSON, json.dumps({"op": "flight_dump"}).encode())
+        kind, data = recv_frame(raw)
+        assert kind == _KIND_JSON
+        resp = json.loads(data)
+        assert is_flight_dump(resp["flight"])
+        assert "#trace" not in resp
+    finally:
+        raw.close()
+
+
+def test_new_client_flight_dump_against_old_server():
+    """Mixed-version (ISSUE 13 satellite): a pre-13 server answers the
+    unknown op with its structured fatal error and closes — the client
+    helper degrades to None ("no black box available"), never a raised
+    failure mode on the postmortem path."""
+    from glt_tpu.distributed.dist_client import RemoteServerConnection
+    from glt_tpu.distributed.dist_server import (_KIND_JSON, recv_frame,
+                                                 send_frame)
+
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+
+    def old_server():
+        conn, _ = listener.accept()
+        with conn:
+            kind, data = recv_frame(conn)
+            op = json.loads(data)["op"]
+            # pre-13 _handle: unknown op -> fatal error, then close.
+            send_frame(conn, _KIND_JSON, json.dumps(
+                {"error": f"unknown op {op!r}", "code": "fatal"}).encode())
+
+    t = threading.Thread(target=old_server, daemon=True)
+    t.start()
+    conn = RemoteServerConnection(listener.getsockname())
+    try:
+        assert conn.flight_dump() is None
+        assert conn.broken        # reconnects on next use
+    finally:
+        conn.close()
+        listener.close()
+        t.join(timeout=10)
+
+
 def test_two_clients_same_server(server):
     l1 = RemoteNeighborLoader(server.addr, [2], np.arange(0, 12),
                               batch_size=6)
