@@ -240,6 +240,10 @@ def main():
     # --- pipelined (headline): enqueue everything, one fetch at the end.
     # GLT_PROFILE_DIR captures a jax profiler trace of this region.
     _progress("pipelined sampler timing")
+    # GLT_PROFILE_TRIGGER_DIR arms spike/SLO-triggered captures for the
+    # rest of the run (obs/profiler.py; no-op when unset).
+    from glt_tpu.obs import profiler as obs_profiler
+    obs_profiler.maybe_arm_from_env()
     prof_dir = os.environ.get("GLT_PROFILE_DIR")
     ctx = profile.trace(prof_dir) if prof_dir else contextlib.nullcontext()
     meter = profile.ThroughputMeter()
@@ -764,6 +768,7 @@ def main():
     # between stages.
     _progress("fused scanned epoch (G8)")
     from glt_tpu.models import make_scanned_node_train_step
+    from glt_tpu.obs import compilewatch as obs_compilewatch
 
     Gn = 4 if small else 8
     sstep = make_scanned_node_train_step(model_bf16, tx, csampler, feat,
@@ -777,6 +782,10 @@ def main():
     st2, ls, _, _ = sstep(st2, jnp.asarray(blocks[0]),
                        jax.random.fold_in(base, 401))  # warm 2 (committed)
     sync(ls[-1])
+    # Steady state must recompile ZERO programs: the delta across the
+    # timed (post-warm) epoch is the runtime check of gltlint GLT003,
+    # tracked DOWN with a <= 0 aspiration by regress.py.
+    compiles_after_warm = obs_compilewatch.total_compiles()
     t0 = time.perf_counter()
     st2 = state0
     for i, blk in enumerate(blocks):
@@ -784,6 +793,8 @@ def main():
                            jax.random.fold_in(base, 500 + i))
     sync(ls[-1])
     epoch_scanned_s = time.perf_counter() - t0
+    compile_count_epoch = (obs_compilewatch.total_compiles()
+                           - compiles_after_warm)
     if obs_trace_path:
         stop_trace(obs_trace_path)
         _progress(f"obs trace written to {obs_trace_path}")
@@ -955,7 +966,13 @@ def main():
     # half of the engine's HBM budget, previously unreported.
     est_sampling_gb_s = edges_per_sec_m * 1e6 * (4 + 20) / 1e9
     est_traffic_gb_s = est_sampling_gb_s + gather_gb_s[gather_best]
-    v5e_hbm = 819.0
+    # Peak bandwidth is backend-resolved (env GLT_HBM_GBPS > device-kind
+    # table > v5e default), with its provenance labelled in the output —
+    # no more silently assuming v5e on every backend.
+    from glt_tpu.obs import device as obs_device
+    from glt_tpu.obs.roofline import peak_hbm_gb_s
+    hbm_bw = peak_hbm_gb_s()
+    hbm_bw_gb_s = float(hbm_bw["gb_s"])
 
     global _DONE
     _DONE = True
@@ -977,7 +994,16 @@ def main():
         "batched_ms_per_batch": round(batched_s / (rounds * G) * 1e3, 3),
         "est_hbm_traffic_gb_s": round(est_traffic_gb_s, 2),
         "est_hbm_traffic_gb_s_sampling": round(est_sampling_gb_s, 2),
-        "est_hbm_fraction": round(est_traffic_gb_s / v5e_hbm, 4),
+        "est_hbm_fraction": round(est_traffic_gb_s / hbm_bw_gb_s, 4),
+        "hbm_bw_gb_s": round(hbm_bw_gb_s, 1),
+        "hbm_bw_source": str(hbm_bw["source"]),
+        # Measured counterparts beside the estimate: the same traffic
+        # over the MEASURED memcpy ceiling, and the device-reported
+        # peak HBM use (None -> pruned on memory_stats-less backends).
+        "hbm_fraction_measured": round(
+            est_traffic_gb_s / max(memcpy_roofline_gb_s, 1e-9), 4),
+        "hbm_peak_bytes": obs_device.peak_bytes_in_use(),
+        "compile_count_epoch": compile_count_epoch,
         # Round-4-comparable split (worst-case cap, f32).  gather_ms is
         # the per-shape WINNER of naive vs dedup (the warmup auto-pick);
         # both variants are reported beside it.

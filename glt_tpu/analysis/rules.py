@@ -1493,6 +1493,122 @@ class WallClockDuration(Rule):
         return False
 
 
+# ---------------------------------------------------------------------------
+# GLT016 unbalanced-profiler-capture
+# ---------------------------------------------------------------------------
+
+@register
+class UnbalancedProfilerCapture(Rule):
+    """``jax.profiler.start_trace`` without a guaranteed stop.
+
+    A profiler trace left open skews every measurement after it and, on
+    TPU, pins the trace buffer until process exit; an exception between
+    ``start_trace`` and ``stop_trace`` leaks the capture exactly when
+    the run is most worth tracing.  The stop must be UNCONDITIONAL — in
+    a ``finally`` block — or the capture should go through the balanced
+    context manager :func:`glt_tpu.obs.profiler.capture` (which carries
+    the try/finally inside).
+
+    Accepted shapes (both used in this tree):
+
+    * the start inside a ``try`` whose ``finally`` stops, and
+    * the start immediately before a ``try`` in the same statement
+      list whose ``finally`` stops (the contextmanager idiom:
+      ``start_trace(d); try: yield; finally: stop_trace()``).
+
+    ``start_server`` pairs with ``stop_server`` the same way.
+    """
+    name = "unbalanced-profiler-capture"
+    code = "GLT016"
+    severity = Severity.ERROR
+    description = ("jax.profiler.start_trace/start_server without the "
+                   "matching stop in a finally (use try/finally or "
+                   "glt_tpu.obs.profiler.capture())")
+
+    _PAIRS = {
+        "jax.profiler.start_trace": "jax.profiler.stop_trace",
+        "jax.profiler.start_server": "jax.profiler.stop_server",
+    }
+
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
+        findings: List[Finding] = []
+        # module.scopes holds only function scopes; a module-level bare
+        # start (scripts, __main__ blocks) leaks the same way.
+        roots = [(module.tree, "<module>")] + [
+            (s.node, s.name) for s in module.scopes]
+        for root, scope_name in roots:
+            starts: List[ast.Call] = []
+            trys: List[ast.Try] = []
+            for node in _walk_own(root):
+                if (isinstance(node, ast.Call)
+                        and module.call_name(node) in self._PAIRS):
+                    starts.append(node)
+                elif isinstance(node, ast.Try):
+                    trys.append(node)
+            if not starts:
+                continue
+            start_ids = {id(n) for n in starts}
+            balanced: Set[int] = set()
+            # Shape 1: start inside a try whose finally has the stop.
+            for t in trys:
+                stops = self._final_stops(module, t)
+                if not stops:
+                    continue
+                for part in (t.body, t.handlers, t.orelse):
+                    for stmt in part:
+                        for n in ast.walk(stmt):
+                            if (id(n) in start_ids and
+                                    self._PAIRS[module.call_name(n)]
+                                    in stops):
+                                balanced.add(id(n))
+            # Shape 2: start before a try (same statement list) whose
+            # finally has the stop — the contextmanager idiom.
+            # (walk_own yields children only, so include the root node:
+            # its .body is the outermost statement list.)
+            for holder in [root, *_walk_own(root)]:
+                for field in ("body", "orelse", "finalbody"):
+                    stmts = getattr(holder, field, None)
+                    if not isinstance(stmts, list):
+                        continue
+                    self._scan_block(module, stmts, start_ids, balanced)
+            for n in starts:
+                if id(n) in balanced:
+                    continue
+                name = module.call_name(n)
+                findings.append(self.finding(
+                    module, n,
+                    f"{name}() in '{scope_name}' without "
+                    f"{self._PAIRS[name].split('.')[-1]}() in a finally "
+                    f"— an exception leaks the capture; wrap in "
+                    f"try/finally or use glt_tpu.obs.profiler.capture()"))
+        return findings
+
+    def _final_stops(self, module: ModuleInfo, t: ast.Try) -> Set[str]:
+        stops: Set[str] = set()
+        for stmt in t.finalbody:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call):
+                    name = module.call_name(n)
+                    if name in self._PAIRS.values():
+                        stops.add(name)
+        return stops
+
+    def _scan_block(self, module: ModuleInfo, stmts: List[ast.stmt],
+                    start_ids: Set[int], balanced: Set[int]) -> None:
+        for i, stmt in enumerate(stmts):
+            pending = [n for n in ast.walk(stmt)
+                       if id(n) in start_ids and id(n) not in balanced]
+            if not pending:
+                continue
+            later_stops: Set[str] = set()
+            for nxt in stmts[i + 1:]:
+                if isinstance(nxt, ast.Try):
+                    later_stops |= self._final_stops(module, nxt)
+            for n in pending:
+                if self._PAIRS[module.call_name(n)] in later_stops:
+                    balanced.add(id(n))
+
+
 def _iter_const_ints(node: ast.expr) -> Iterator[int]:
     if isinstance(node, ast.Constant) and isinstance(node.value, int):
         yield node.value

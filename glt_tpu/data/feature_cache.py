@@ -57,6 +57,9 @@ def cache_init(num_ids: int, capacity: int, dim: int,
     """Empty cache over an id space of ``num_ids`` global ids."""
     if capacity <= 0:
         raise ValueError(f"cache capacity must be positive, got {capacity}")
+    from ..obs import device as _device
+    _device.register_owner("feature_cache", shape=(capacity + 1, dim),
+                           dtype=dtype)
     return FeatureCacheState(
         table=jnp.zeros((capacity + 1, dim), dtype),
         slot_ids=jnp.full((capacity + 1,), -1, jnp.int32),

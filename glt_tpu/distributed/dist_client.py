@@ -362,6 +362,30 @@ class RemoteServerConnection:
         flight = resp.get("flight")
         return flight if isinstance(flight, dict) else None
 
+    def profile_capture(self, dir: Optional[str] = None,
+                        millis: float = 50.0,
+                        retries: int = 0) -> Optional[dict]:
+        """Trigger a bounded profiler capture on the server host
+        (``profile_capture`` op, docs/observability.md "Triggered
+        profiling").
+
+        Returns ``{"ok", "dir", "millis"}`` naming the server-side
+        capture directory, or **None against a pre-14 server** — the
+        unknown-op fatal error (and any transport failure) degrades to
+        "no capture available", never a new failure mode; the
+        connection reconnects on next use.
+        """
+        req: dict = {"op": "profile_capture", "millis": float(millis),
+                     "_retries": int(retries)}
+        if dir is not None:
+            req["dir"] = str(dir)
+        try:
+            resp = self.request(**req)
+        except (RuntimeError, OSError):
+            self._broken = True       # old server closed after the error
+            return None
+        return resp if isinstance(resp, dict) and resp.get("ok") else None
+
     @property
     def broken(self) -> bool:
         return self._broken

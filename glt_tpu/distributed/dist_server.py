@@ -654,6 +654,29 @@ class DistServer:
                 snap["path"] = _flight.dump_now("wire_op",
                                                 path=str(req["path"]))
             return {"flight": snap}
+        if op == "profile_capture":
+            # Triggered XLA profiler capture on the SERVER host
+            # (docs/observability.md "Triggered profiling"): a bounded
+            # jax.profiler trace into `dir` (a fresh temp dir when
+            # unset), indexed in the server's flight ring.  A pre-14
+            # server answers with its unknown-op fatal error; the
+            # client helper degrades to None (mixed-version contract).
+            import tempfile
+
+            from ..obs import profiler as _obs_profiler
+            millis = min(float(req.get("millis", 50.0)),
+                         _obs_profiler.MAX_CAPTURE_MILLIS)
+            pdir = (str(req["dir"]) if req.get("dir")
+                    else tempfile.mkdtemp(prefix="glt_profile_"))
+            _flight.record("server.profile_capture_served",
+                           dir=pdir, millis=millis)
+            try:
+                with _obs_profiler.capture(pdir, millis=millis,
+                                           reason="wire_op"):
+                    pass
+            except Exception as e:  # noqa: BLE001 — structured reply,
+                return {"ok": False, "error": repr(e)}  # not a close
+            return {"ok": True, "dir": pdir, "millis": millis}
         if op == "start_new_epoch_sampling":
             self._get_producer(req).start_epoch(
                 int(req.get("epoch", 0)), trace_ctx=trace_ctx)

@@ -30,8 +30,11 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ..obs import compilewatch as _compilewatch
+from ..obs import device as _device
 from ..obs import flight as _flight
 from ..obs import metrics as _metrics
+from ..obs import profiler as _profiler
 from ..obs.trace import span as _span
 from ..typing import PADDING_ID
 
@@ -57,6 +60,8 @@ class TrainState(NamedTuple):
 def create_train_state(model, rng, sample_batch, tx) -> TrainState:
     params = model.init({"params": rng}, sample_batch.x,
                         sample_batch.edge_index, sample_batch.edge_mask)
+    for leaf in jax.tree_util.tree_leaves(params):
+        _device.register_owner("params", array=leaf)
     return TrainState(params=params, opt_state=tx.init(params),
                       step=jnp.zeros((), jnp.int32))
 
@@ -291,10 +296,11 @@ def make_scanned_node_train_step(model, tx, sampler, rows, labels,
     cache_holder = {"cache": feature_cache}
 
     def step(state: TrainState, seeds_blk, key):
-        state, cache_holder["cache"], losses, accs, ovfs = run(
-            g.indptr, g.indices, g.gather_edge_ids, hot_rows,
-            labels, state, cache_holder["cache"],
-            jnp.asarray(seeds_blk, jnp.int32), key)
+        with _compilewatch.label("scanned_node_step"):
+            state, cache_holder["cache"], losses, accs, ovfs = run(
+                g.indptr, g.indices, g.gather_edge_ids, hot_rows,
+                labels, state, cache_holder["cache"],
+                jnp.asarray(seeds_blk, jnp.int32), key)
         return state, losses, accs, ovfs
 
     step.feature_cache = lambda: cache_holder["cache"]
@@ -384,8 +390,14 @@ def run_scanned_epoch(step, state, train_idx, batch_size: int,
                 # gltlint: disable-next=dispatch-in-epoch-loop
                 jax.block_until_ready(state)
                 on_block(state, i)
-            _M_BLOCK_MS.observe((time.perf_counter() - t_blk0) * 1e3)
+            blk_ms = (time.perf_counter() - t_blk0) * 1e3
+            _M_BLOCK_MS.observe(blk_ms)
+            # Spike-triggered profiler capture (no-op while disarmed).
+            _profiler.spike_observe(blk_ms)
         _M_EPOCHS.inc()
+        # Epoch boundary: refresh glt.device.* gauges (absent on CPU)
+        # and advance the live-bytes leak watch.
+        _device.observe_epoch()
         _flight.record("train.epoch",
                        blocks=len(blocks) - int(start_block),
                        start_block=int(start_block),
@@ -546,8 +558,9 @@ def make_scanned_hetero_train_step(model, tx, sampler, feats, labels,
         return state, losses, accs
 
     def step(state: TrainState, seeds_blk, key):
-        return run(graph_arrays, rows, labels_tgt, state,
-                   jnp.asarray(seeds_blk, jnp.int32), key)
+        with _compilewatch.label("scanned_hetero_step"):
+            return run(graph_arrays, rows, labels_tgt, state,
+                       jnp.asarray(seeds_blk, jnp.int32), key)
 
     return step
 
@@ -633,10 +646,11 @@ def make_scanned_link_train_step(model, tx, sampler, rows, loss_fn,
     def step(params, opt_state, src_blk, dst_blk, key):
         sorted_ix = g.sorted_indices if mode is not None else g.indices
         cdf_arg = (jnp.zeros((1,), jnp.float32) if cdf is None else cdf)
-        return run(g.indptr, g.indices, g.gather_edge_ids, sorted_ix,
-                   hot_rows, params, opt_state,
-                   jnp.asarray(src_blk, jnp.int32),
-                   jnp.asarray(dst_blk, jnp.int32), cdf_arg, key)
+        with _compilewatch.label("scanned_link_step"):
+            return run(g.indptr, g.indices, g.gather_edge_ids, sorted_ix,
+                       hot_rows, params, opt_state,
+                       jnp.asarray(src_blk, jnp.int32),
+                       jnp.asarray(dst_blk, jnp.int32), cdf_arg, key)
 
     return step
 
@@ -722,10 +736,11 @@ def make_scanned_subgraph_train_step(model, tx, sampler, rows, loss_fn,
         return params, opt_state, losses
 
     def step(params, opt_state, seeds_blk, y_blk, key):
-        return run(g.indptr, g.indices, g.gather_edge_ids, g.edge_ids,
-                   hot_rows, params, opt_state,
-                   jnp.asarray(seeds_blk, jnp.int32),
-                   jnp.asarray(y_blk), key)
+        with _compilewatch.label("scanned_subgraph_step"):
+            return run(g.indptr, g.indices, g.gather_edge_ids, g.edge_ids,
+                       hot_rows, params, opt_state,
+                       jnp.asarray(seeds_blk, jnp.int32),
+                       jnp.asarray(y_blk), key)
 
     return step
 

@@ -25,8 +25,11 @@ never call span()/inc() inside a jit-traced function (gltlint GLT010).
 >>> obs.metrics.snapshot()["glt.loader.batches"]
 """
 from . import attrib  # noqa: F401  (stdlib-only; jax imports are lazy)
+from . import compilewatch  # noqa: F401  (stdlib-only; lazy jax)
+from . import device  # noqa: F401  (stdlib-only; jax imports are lazy)
 from . import flight  # noqa: F401  (stdlib-only; safe without jax)
 from . import metrics  # noqa: F401  (stdlib-only; safe without jax)
+from . import profiler  # noqa: F401  (stdlib-only; jax imports lazy)
 from . import slo  # noqa: F401  (stdlib-only; safe without jax)
 from .flight import (  # noqa: F401
     FlightRecorder,
@@ -37,7 +40,12 @@ from .merge import merge_traces, span_tree_check  # noqa: F401
 from .metrics import prune_unmeasured  # noqa: F401
 from .slo import SloMonitor, SloSpec, default_specs  # noqa: F401
 from .roofline import measure_memcpy_roofline, roofline_fraction  # noqa: F401
-from .summarize import format_summary, summarize_trace  # noqa: F401
+from .summarize import (  # noqa: F401
+    format_flight_summary,
+    format_summary,
+    summarize_flight,
+    summarize_trace,
+)
 from .trace import (  # noqa: F401
     Span,
     Tracer,
@@ -59,10 +67,14 @@ __all__ = [
     "Tracer",
     "attrib",
     "auto_trace",
+    "compilewatch",
+    "device",
+    "profiler",
     "auto_trace_export",
     "current",
     "default_specs",
     "flight",
+    "format_flight_summary",
     "format_summary",
     "install",
     "measure_memcpy_roofline",
@@ -77,6 +89,7 @@ __all__ = [
     "span_tree_check",
     "start_trace",
     "stop_trace",
+    "summarize_flight",
     "summarize_trace",
     "validate_chrome_trace",
 ]

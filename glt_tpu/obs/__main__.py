@@ -18,7 +18,8 @@ import sys
 
 from .flight import is_flight_dump, merge_flight_dumps, validate_flight_dump
 from .merge import merge_traces
-from .summarize import format_summary, load_trace, summarize_trace
+from .summarize import (format_flight_summary, format_summary, load_trace,
+                        summarize_flight, summarize_trace)
 from .trace import validate_chrome_trace
 
 
@@ -28,8 +29,10 @@ def main(argv=None) -> int:
         description="glt_tpu observability: trace summary, validation, "
                     "and cross-process merge")
     sub = parser.add_subparsers(dest="cmd", required=True)
-    p_sum = sub.add_parser("summarize",
-                           help="aggregate a Chrome-trace JSON by span")
+    p_sum = sub.add_parser(
+        "summarize",
+        help="aggregate a Chrome-trace JSON by span (flight dumps "
+             "summarize into device-memory/compile/capture sections)")
     p_sum.add_argument("trace")
     p_sum.add_argument("--sort", default="total",
                        choices=("total", "self", "count", "max"),
@@ -104,6 +107,14 @@ def main(argv=None) -> int:
             print(f"OK: {n} events, spans nest, durations non-negative")
         return 1 if problems else 0
 
+    if is_flight_dump(obj):
+        # Flight dumps summarize into device-memory / compile / capture
+        # sections (docs/observability.md) — same auto-routing as
+        # validate/merge.
+        summary = summarize_flight(obj)
+        print(json.dumps(summary) if args.json
+              else format_flight_summary(summary))
+        return 0
     rows = summarize_trace(obj)
     key = {"total": "total_ms", "self": "self_ms", "count": "count",
            "max": "max_ms"}[args.sort]

@@ -1,0 +1,216 @@
+"""Runtime recompile telemetry: count and time XLA compilations.
+
+gltlint GLT003 catches recompile *hazards* statically (unhashable
+static args, python scalars re-traced per call); this module closes the
+loop at runtime — every XLA compilation the process performs is
+counted, timed, and attributed to a **labelled program** so a steady
+state that should compile zero times per epoch is a measurable claim
+(``compile_count_epoch`` in bench output, tracked DOWN by regress.py
+with a ``<= 0`` aspiration).
+
+Two cooperating pieces:
+
+* **Monitoring hook.**  ``jax.monitoring`` fires a duration event per
+  backend compilation (``/jax/core/compile/backend_compile_duration``)
+  but carries no program identity.  :func:`install` registers one
+  listener (idempotent, lazy — no jax import until first use).
+* **Label seam.**  A thread-local label stack supplies the identity the
+  hook lacks: wrap a jit *call site* (where compilation actually
+  happens — first call, or a shape/dtype miss) in
+  :func:`label`/``wrap(fn, program)`` and every compilation triggered
+  under it lands in ``glt.compile.count{program=...}`` /
+  ``glt.compile.ms{program=...}``.  Unwrapped compilations count under
+  ``program=unlabelled``.
+
+On top of the per-program counts rides the **recompile-storm
+detector**: the same program key compiled more than ``STORM_K`` times
+inside ``STORM_WINDOW_S`` seconds raises a ``compile.storm`` flight
+event and sets ``glt.compile.storm{program=...}`` — the runtime
+signature of the bucket-churn / python-scalar-key bugs GLT003 hunts in
+source.  ``glt.compile.recompiles`` (re-compilations of an
+already-seen label) over ``glt.compile.first`` (first-time
+compilations) is the SLO-able ratio (:func:`storm_ratio_spec`).
+
+Module-level code is stdlib-only (the :mod:`.roofline` pattern); jax
+imports happen inside :func:`install`.  All window math uses
+``time.monotonic()`` (GLT015).
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+import time
+from typing import Callable, Deque, Dict, Optional
+
+from . import flight as _flight
+from . import metrics as _metrics
+
+#: Storm threshold: strictly more than K backend compilations of one
+#: program label inside the window is a storm.  One ``jit`` call fires
+#: 2-3 backend_compile events (the main program plus small helper
+#: programs), so a healthy first compile lands well under K=8 while a
+#: per-call re-tracing bug produces dozens per epoch.
+STORM_K = 8
+STORM_WINDOW_S = 60.0
+
+#: The jax.monitoring event that marks one backend compilation.
+_COMPILE_EVENT_SUFFIX = "backend_compile_duration"
+
+_M_FIRST = _metrics.counter(
+    "glt.compile.first", "first-time XLA compilations (all programs)")
+_M_RECOMPILES = _metrics.counter(
+    "glt.compile.recompiles",
+    "re-compilations of an already-compiled program label")
+
+_tls = threading.local()
+_lock = threading.Lock()
+_installed = False
+_install_failed = False
+#: cumulative compile count per program label (monotonic; read by
+#: :func:`counts` for the bench/CI "second epoch compiles zero" check).
+_counts: Dict[str, int] = {}
+#: recent compile stamps per label (storm window) + whether a storm was
+#: already reported for the current burst.
+_stamps: Dict[str, Deque[float]] = {}
+_storm_reported: Dict[str, bool] = {}
+
+
+def current_label() -> str:
+    """The innermost active program label (``unlabelled`` outside any)."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else "unlabelled"
+
+
+def install() -> bool:
+    """Register the jax.monitoring compile listener (idempotent).
+
+    Returns True when the listener is (already) active, False when jax
+    or its monitoring API is unavailable — callers never need to care.
+    """
+    global _installed, _install_failed
+    if _installed:
+        return True
+    if _install_failed:
+        return False
+    with _lock:
+        if _installed:
+            return True
+        try:
+            from jax import monitoring as _monitoring
+            _monitoring.register_event_duration_secs_listener(_on_event)
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            _install_failed = True
+            return False
+        _installed = True
+    return True
+
+
+def _on_event(event: str, duration_s: float, **kw) -> None:
+    if not event.endswith(_COMPILE_EVENT_SUFFIX):
+        return
+    try:
+        _note_compile(current_label(), float(duration_s) * 1000.0)
+    except Exception:  # noqa: BLE001 — inside the runtime's hot hook
+        pass
+
+
+def _note_compile(program: str, dur_ms: float,
+                  now: Optional[float] = None) -> None:
+    now = time.monotonic() if now is None else now
+    _metrics.counter("glt.compile.count",
+                     "XLA compilations per labelled program",
+                     labels={"program": program}).inc()
+    _metrics.histogram("glt.compile.ms",
+                       "XLA compilation wall time per labelled program",
+                       labels={"program": program}).observe(dur_ms)
+    with _lock:
+        seen = _counts.get(program, 0)
+        _counts[program] = seen + 1
+        dq = _stamps.setdefault(
+            program, collections.deque())
+        dq.append(now)
+        while dq and now - dq[0] > STORM_WINDOW_S:
+            dq.popleft()
+        storm = len(dq) > STORM_K
+        if not storm:
+            _storm_reported[program] = False
+        report = storm and not _storm_reported.get(program, False)
+        if report:
+            _storm_reported[program] = True
+        burst = len(dq)
+    if seen:
+        _M_RECOMPILES.inc()
+    else:
+        _M_FIRST.inc()
+    if report:
+        _metrics.gauge("glt.compile.storm",
+                       "recompile storm in progress (burst size)",
+                       labels={"program": program}).set(burst)
+        _flight.record("compile.storm", program=program, count=burst,
+                       window_s=STORM_WINDOW_S, threshold=STORM_K)
+
+
+@contextlib.contextmanager
+def label(program: str):
+    """Attribute compilations inside the block to ``program``.
+
+    Wrap the *call site* of a jit'd function (compilation happens on
+    the first call for each shape/dtype signature, not at decoration).
+    Costs a thread-local append/pop when the listener is installed.
+    """
+    install()
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(str(program))
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def wrap(fn: Callable, program: str) -> Callable:
+    """``fn`` with every call running under ``label(program)``."""
+    def wrapper(*args, **kwargs):
+        with label(program):
+            return fn(*args, **kwargs)
+    wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+    return wrapper
+
+
+def counts(program: Optional[str] = None):
+    """Cumulative compile counts: ``{label: n}``, or one label's n."""
+    with _lock:
+        if program is not None:
+            return _counts.get(program, 0)
+        return dict(_counts)
+
+
+def total_compiles() -> int:
+    with _lock:
+        return sum(_counts.values())
+
+
+def storm_ratio_spec(objective: float = 0.2, **kw):
+    """An :class:`~glt_tpu.obs.slo.SloSpec` over the recompile fraction.
+
+    Ratio semantics match slo.py: ``metric`` is the bad counter,
+    ``denom`` the good one, windowed value = bad / (bad + good).  A
+    steady-state process recompiles nothing, so any sustained fraction
+    above ``objective`` burns.
+    """
+    from .slo import SloSpec
+    return SloSpec(name=kw.pop("name", "compile_storm"),
+                   metric="glt.compile.recompiles",
+                   denom="glt.compile.first",
+                   objective=objective, kind="ratio",
+                   comparison="<=", **kw)
+
+
+def reset_for_tests() -> None:
+    """Clear label-seam state (counts/stamps), not the listener."""
+    with _lock:
+        _counts.clear()
+        _stamps.clear()
+        _storm_reported.clear()

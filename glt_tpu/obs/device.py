@@ -1,0 +1,265 @@
+"""Per-device HBM accounting: gauges, owner classification, leak watch.
+
+Every obs layer so far watches the engine from the host; this module
+reads what the *device* reports about itself and publishes it through
+the same ``glt.*`` registry the rest of the stack already scrapes:
+
+* :func:`publish_device_stats` — ``glt.device.*`` gauges per device
+  (``bytes_in_use``, ``peak_bytes``, ``largest_alloc``, ``num_allocs``,
+  plus any pool-level keys the backend exposes) from
+  ``device.memory_stats()``.  Backends that return ``None`` (CPU — the
+  tier-1 environment) publish **no gauges and never raise**: absent
+  data reads as absent, not as zero.
+* :func:`snapshot` — classifies ``jax.live_arrays()`` by **owner**
+  using shape+dtype fingerprints registered at allocation sites
+  (:func:`register_owner`: feature cache, stager, params, serving
+  buckets).  Unmatched arrays land in ``other`` so the report always
+  sums to the live total.
+* :class:`LeakWatch` — epoch-boundary growth detector.  Live bytes
+  (``memory_stats()['bytes_in_use']`` where available, the summed
+  ``jax.live_arrays()`` sizes otherwise — so the watch works on CPU)
+  growing monotonically across ``epochs`` consecutive boundaries is a
+  leak suspect: ``device.leak_suspect`` flight event +
+  ``glt.device.leak_suspect`` gauge with the growth run length.  The
+  gauge clears the moment an epoch stops growing.
+
+Module-level code is stdlib-only; jax imports are lazy and every entry
+point degrades to a no-op when jax is absent or a backend call fails —
+telemetry must never take the engine down.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import flight as _flight
+from . import metrics as _metrics
+
+#: memory_stats keys published 1:1 as ``glt.device.<key>`` when present.
+_STAT_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+              "largest_alloc_size", "num_allocs", "bytes_reserved",
+              "largest_free_block_bytes", "pool_bytes", "peak_pool_bytes")
+#: ``memory_stats`` spellings vary per backend; map to our gauge names.
+_STAT_ALIASES = {"peak_bytes_in_use": "peak_bytes",
+                 "largest_alloc_size": "largest_alloc"}
+
+_lock = threading.Lock()
+#: ``(shape, dtype) -> owner`` fingerprints, registered at allocation
+#: sites.  First registration wins (a fingerprint is only useful while
+#: it is unambiguous; later claimants keep their site-local name out).
+_owners: Dict[Tuple[Tuple[int, ...], str], str] = {}
+
+
+def _canon_dtype(dtype) -> str:
+    # ``jnp.float32`` (a type), ``np.dtype('float32')``, and the string
+    # "float32" must all land on one spelling or fingerprints never
+    # match across registration/census sites.
+    try:
+        import numpy as np
+        return str(np.dtype(dtype))
+    except Exception:  # noqa: BLE001
+        return str(dtype)
+
+
+def _fingerprint(shape, dtype) -> Tuple[Tuple[int, ...], str]:
+    return tuple(int(s) for s in shape), _canon_dtype(dtype)
+
+
+def register_owner(owner: str, array: Any = None,
+                   shape: Optional[Tuple[int, ...]] = None,
+                   dtype: Any = None) -> None:
+    """Claim a shape+dtype fingerprint for ``owner`` (never raises).
+
+    Call at the allocation site with either the array itself or its
+    ``shape``/``dtype``; :func:`snapshot` then attributes any live
+    array matching the fingerprint to this owner.
+    """
+    try:
+        if array is not None:
+            shape, dtype = array.shape, array.dtype
+        fp = _fingerprint(shape, dtype)
+    except Exception:  # noqa: BLE001 — telemetry must never raise
+        return
+    with _lock:
+        _owners.setdefault(fp, str(owner))
+
+
+def owners() -> Dict[Tuple[Tuple[int, ...], str], str]:
+    with _lock:
+        return dict(_owners)
+
+
+def reset_owners_for_tests() -> None:
+    with _lock:
+        _owners.clear()
+
+
+def _live_arrays() -> List[Any]:
+    try:
+        import jax
+        return list(jax.live_arrays())
+    except Exception:  # noqa: BLE001
+        return []
+
+
+def _device_stats() -> List[Tuple[str, Dict[str, float]]]:
+    """``[(device_str, memory_stats), ...]`` for devices that report."""
+    try:
+        import jax
+        devices = jax.devices()
+    except Exception:  # noqa: BLE001
+        return []
+    out: List[Tuple[str, Dict[str, float]]] = []
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001
+            stats = None
+        if stats:
+            out.append((str(dev), dict(stats)))
+    return out
+
+
+def publish_device_stats() -> Dict[str, float]:
+    """Set ``glt.device.*`` gauges from ``device.memory_stats()``.
+
+    Returns what was published (flat ``{gauge{device=}: value}``).
+    Empty — with NO gauges registered — on backends whose
+    ``memory_stats()`` is ``None`` (CPU) or when jax is absent.
+    """
+    published: Dict[str, float] = {}
+    for dev, stats in _device_stats():
+        for key in _STAT_KEYS:
+            if key not in stats:
+                continue
+            name = "glt.device." + _STAT_ALIASES.get(key, key)
+            try:
+                v = float(stats[key])
+            except (TypeError, ValueError):
+                continue
+            g = _metrics.gauge(name, "device memory accounting "
+                                     "(memory_stats passthrough)",
+                               labels={"device": dev})
+            g.set(v)
+            published[g.full_name] = v
+    return published
+
+
+def peak_bytes_in_use() -> Optional[int]:
+    """Max ``peak_bytes_in_use`` across reporting devices, else None.
+
+    None (not 0) on CPU — bench.py prunes unmeasured metrics rather
+    than publishing a fake zero peak.
+    """
+    best: Optional[int] = None
+    for _, stats in _device_stats():
+        v = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+        if v is None:
+            continue
+        best = int(v) if best is None else max(best, int(v))
+    return best
+
+
+def live_bytes() -> int:
+    """Total live-array bytes: device-reported where possible, the
+    summed ``jax.live_arrays()`` sizes otherwise (CPU fallback)."""
+    reported = [s.get("bytes_in_use") for _, s in _device_stats()]
+    reported = [v for v in reported if v is not None]
+    if reported:
+        return int(sum(reported))
+    total = 0
+    for arr in _live_arrays():
+        try:
+            total += int(arr.nbytes)
+        except Exception:  # noqa: BLE001
+            pass
+    return total
+
+
+def snapshot() -> Dict[str, Any]:
+    """Live-array census classified by registered owner fingerprints.
+
+    ``{"total": {count, bytes}, "owners": {owner: {count, bytes}},
+    "devices": {device: stats...}}`` — ``other`` absorbs every live
+    array no fingerprint claims, so owners always sum to the total.
+    Empty-but-well-formed when jax is absent.
+    """
+    with _lock:
+        fps = dict(_owners)
+    by_owner: Dict[str, Dict[str, int]] = {}
+    total_n = 0
+    total_b = 0
+    for arr in _live_arrays():
+        try:
+            fp = _fingerprint(arr.shape, arr.dtype)
+            nbytes = int(arr.nbytes)
+        except Exception:  # noqa: BLE001
+            continue
+        owner = fps.get(fp, "other")
+        slot = by_owner.setdefault(owner, {"count": 0, "bytes": 0})
+        slot["count"] += 1
+        slot["bytes"] += nbytes
+        total_n += 1
+        total_b += nbytes
+    return {
+        "total": {"count": total_n, "bytes": total_b},
+        "owners": by_owner,
+        "devices": {dev: stats for dev, stats in _device_stats()},
+    }
+
+
+class LeakWatch:
+    """Monotonic live-bytes growth across epoch boundaries.
+
+    Call :meth:`observe_epoch` once per epoch.  ``epochs`` consecutive
+    boundary-to-boundary increases flag a suspect; the gauge carries
+    the current growth-run length (0 when healthy) so dashboards see
+    both the binary state and how long the climb has lasted.
+    """
+
+    def __init__(self, epochs: int = 3, min_growth_bytes: int = 1):
+        self.epochs = max(int(epochs), 1)
+        self.min_growth_bytes = max(int(min_growth_bytes), 1)
+        self._last: Optional[int] = None
+        self._run = 0
+        self._lock = threading.Lock()
+        self._gauge = _metrics.gauge(
+            "glt.device.leak_suspect",
+            "consecutive epochs of live-bytes growth "
+            "(>= leak-watch threshold => suspect)")
+
+    def observe_epoch(self, live: Optional[int] = None) -> Dict[str, Any]:
+        """Record one epoch boundary; returns the watch state."""
+        try:
+            live = live_bytes() if live is None else int(live)
+        except Exception:  # noqa: BLE001
+            return {"live_bytes": None, "run": 0, "suspect": False}
+        with self._lock:
+            grew = (self._last is not None
+                    and live - self._last >= self.min_growth_bytes)
+            self._run = self._run + 1 if grew else 0
+            self._last = live
+            run = self._run
+        suspect = run >= self.epochs
+        self._gauge.set(run if suspect else 0)
+        if suspect:
+            _flight.record("device.leak_suspect", live_bytes=live,
+                           growth_epochs=run, threshold=self.epochs)
+        return {"live_bytes": live, "run": run, "suspect": suspect}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._last = None
+            self._run = 0
+        self._gauge.set(0)
+
+
+#: Process-default watch, wired at the scanned-epoch boundary
+#: (models/train.py); tests construct their own instances.
+_default_watch = LeakWatch()
+
+
+def observe_epoch() -> Dict[str, Any]:
+    """Epoch-boundary hook: default leak watch + device gauges."""
+    publish_device_stats()
+    return _default_watch.observe_epoch()

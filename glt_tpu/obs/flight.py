@@ -362,6 +362,13 @@ def merge_flight_dumps(paths: Sequence[str],
         "sources": sources,
         "events": merged,
     }
+    # Fold the triggered-profiler capture index into the merged
+    # timeline (obs/profiler.py): the postmortem reader sees which
+    # trace directory belongs to which incident without scanning the
+    # whole event stream.
+    captures = [ev for ev in merged if ev.get("kind") == "profiler.capture"]
+    if captures:
+        result["captures"] = captures
     if out is not None:
         tmp = f"{out}.tmp-{os.getpid()}"
         with open(tmp, "w") as fh:

@@ -93,6 +93,15 @@ EXPLICIT_DIRECTIONS: Dict[str, int] = {
     "bytes_from_disk": NEUTRAL,
     "bytes_from_hbm": NEUTRAL,
     "store_budget_bytes": NEUTRAL,
+    # Device telemetry (ISSUE 14, glt_tpu/obs/device.py +
+    # compilewatch.py): measured peak HBM use is a workload property
+    # (NEUTRAL) but bounded by CEILINGS below; steady-state epochs must
+    # recompile ZERO programs, so the per-epoch compile count tracks
+    # DOWN with a <= 0 aspiration.
+    "hbm_peak_bytes": NEUTRAL,
+    "hbm_bw_gb_s": NEUTRAL,
+    "hbm_fraction_measured": UP,
+    "compile_count_epoch": DOWN,
     # Environment / configuration readings — not better or worse.
     "tunnel_rtt_ms": NEUTRAL,
     "dedup_ratio": NEUTRAL,
@@ -148,6 +157,18 @@ ASPIRATIONS: Dict[str, Tuple[str, float]] = {
     # Disk tier (ISSUE 12): the warmed stager must absorb at least half
     # of cold traffic in DRAM on the skewed bench workload.
     "dram_hit_rate": (">=", 0.5),
+    # Runtime recompile telemetry (ISSUE 14): a steady-state fused
+    # epoch compiles nothing — any flat nonzero count is stuck.
+    "compile_count_epoch": ("<=", 0.0),
+}
+
+#: NEUTRAL-with-ceiling: metrics with no better/worse direction that
+#: must still stay under a hard bound.  A NEUTRAL metric normally
+#: short-circuits to ``info``; exceeding its ceiling verdicts
+#: ``regress`` instead (measured peak HBM use is a workload reading —
+#: until it stops fitting the chip).
+CEILINGS: Dict[str, float] = {
+    "hbm_peak_bytes": 16 * 2**30,     # v5e HBM capacity
 }
 
 
@@ -269,7 +290,13 @@ def compare(
                                                       else float("inf"))
         row["rel_delta"] = rel
         if d == NEUTRAL:
-            row["status"] = "info"
+            ceiling = CEILINGS.get(metric)
+            if ceiling is not None and latest > ceiling:
+                row["status"] = "regress"
+                row["ceiling"] = ceiling
+                regressions.append(metric)
+            else:
+                row["status"] = "info"
             rows.append(row)
             continue
         # Robust spread of the history: MAD scaled to sigma.

@@ -21,8 +21,26 @@ Methodology (docs/observability.md "Roofline"):
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict
+
+#: Datasheet HBM bandwidth (GB/s) by device-kind substring, most
+#: specific first (matched against a lowercased, space-stripped
+#: ``device_kind``).  The table exists so roofline fractions stop
+#: silently assuming v5e on every backend; ``GLT_HBM_GBPS`` overrides
+#: it for hardware the table doesn't know.
+DEVICE_HBM_GB_S = (
+    ("v6e", 1640.0),
+    ("v5p", 2765.0),
+    ("v5e", 819.0),
+    ("v5lite", 819.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+#: Last-resort spec constant (the historical hard-coded v5e number).
+DEFAULT_HBM_GB_S = 819.0
 
 
 def measure_memcpy_roofline(nbytes: int = 1 << 27, iters: int = 10,
@@ -60,3 +78,40 @@ def measure_memcpy_roofline(nbytes: int = 1 << 27, iters: int = 10,
 def roofline_fraction(achieved_gb_s: float, roofline_gb_s: float) -> float:
     """Achieved bandwidth as a fraction of the measured roofline."""
     return float(achieved_gb_s) / max(float(roofline_gb_s), 1e-9)
+
+
+def peak_hbm_gb_s(measure_fallback: bool = False) -> Dict[str, object]:
+    """Resolve the peak HBM bandwidth WITH its provenance.
+
+    Returns ``{"gb_s": float, "source": str}`` where source is one of
+    ``env`` (``GLT_HBM_GBPS`` override), ``device_kind:<kind>`` (the
+    datasheet table), ``measured_memcpy`` (opt-in small memcpy probe
+    when the backend is unknown), or ``default_v5e``.  bench.py labels
+    its ``est_hbm_fraction`` with the source so a fraction computed
+    against the wrong ceiling is visible, not silent.
+    """
+    env = os.environ.get("GLT_HBM_GBPS")
+    if env:
+        try:
+            return {"gb_s": float(env), "source": "env"}
+        except ValueError:
+            pass
+    kind = None
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — resolution must never raise
+        kind = None
+    if kind:
+        canon = str(kind).lower().replace(" ", "")
+        for sub, gb_s in DEVICE_HBM_GB_S:
+            if sub in canon:
+                return {"gb_s": gb_s, "source": f"device_kind:{kind}"}
+    if measure_fallback:
+        try:
+            probe = measure_memcpy_roofline(nbytes=1 << 24, iters=4)
+            return {"gb_s": probe["memcpy_gb_s"],
+                    "source": "measured_memcpy"}
+        except Exception:  # noqa: BLE001
+            pass
+    return {"gb_s": DEFAULT_HBM_GB_S, "source": "default_v5e"}

@@ -71,6 +71,78 @@ def format_summary(rows: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def summarize_flight(obj: dict) -> dict:
+    """Sectioned summary of a flight-recorder dump (single or merged).
+
+    ``{"reason", "events_total", "kinds", "device", "compile",
+    "captures", "slo"}`` — the device-memory and compile sections are
+    the postmortem's first questions ("was it leaking?", "was it
+    recompiling?") answered without scrolling the raw event stream.
+    """
+    events = [e for e in obj.get("events", []) if isinstance(e, dict)]
+    kinds: Dict[str, int] = {}
+    for ev in events:
+        k = str(ev.get("kind", "?"))
+        kinds[k] = kinds.get(k, 0) + 1
+    leaks = [e for e in events if e.get("kind") == "device.leak_suspect"]
+    storms = [e for e in events if e.get("kind") == "compile.storm"]
+    captures = [e for e in events if e.get("kind") == "profiler.capture"]
+    alerts = [e for e in events if e.get("kind") == "slo.alert"]
+    return {
+        "reason": obj.get("reason"),
+        "events_total": len(events),
+        "kinds": dict(sorted(kinds.items(), key=lambda kv: -kv[1])),
+        "device": {
+            "leak_suspects": len(leaks),
+            "last_leak": leaks[-1] if leaks else None,
+        },
+        "compile": {
+            "storms": len(storms),
+            "storm_programs": sorted({str(e.get("program", "?"))
+                                      for e in storms}),
+            "last_storm": storms[-1] if storms else None,
+        },
+        "captures": [{"dir": e.get("dir"), "reason": e.get("reason"),
+                      "ms": e.get("ms")} for e in captures],
+        "slo": {
+            "alerts": len(alerts),
+            "firing": sorted({str(e.get("slo", "?")) for e in alerts
+                              if e.get("state") == "firing"}),
+        },
+    }
+
+
+def format_flight_summary(summary: dict) -> str:
+    lines = [f"flight dump: {summary['events_total']} events "
+             f"(reason={summary['reason']!r})", "", "Event kinds:"]
+    for kind, n in summary["kinds"].items():
+        lines.append(f"  {kind:<28} {n}")
+    dev = summary["device"]
+    lines += ["", "Device memory:"]
+    if dev["leak_suspects"]:
+        last = dev["last_leak"] or {}
+        lines.append(f"  LEAK SUSPECT x{dev['leak_suspects']} — live "
+                     f"{last.get('live_bytes')} B after "
+                     f"{last.get('growth_epochs')} growing epochs")
+    else:
+        lines.append("  no leak suspects")
+    comp = summary["compile"]
+    lines += ["", "Compile:"]
+    if comp["storms"]:
+        lines.append(f"  RECOMPILE STORM x{comp['storms']} — programs: "
+                     + ", ".join(comp["storm_programs"]))
+    else:
+        lines.append("  no recompile storms")
+    lines += ["", f"Profiler captures: {len(summary['captures'])}"]
+    for cap in summary["captures"]:
+        lines.append(f"  {cap['reason']:<24} {cap['dir']}")
+    slo = summary["slo"]
+    lines += ["", f"SLO alerts: {slo['alerts']}"
+              + (f" (fired: {', '.join(slo['firing'])})"
+                 if slo["firing"] else "")]
+    return "\n".join(lines)
+
+
 def load_trace(path: str) -> dict:
     with open(path) as f:
         return json.load(f)

@@ -38,6 +38,8 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..channel.base import SampleMessage
+from ..obs import compilewatch as _compilewatch
+from ..obs import device as _device
 from ..sampler.base import NodeSamplerInput
 from ..sampler.neighbor_sampler import NeighborSampler
 from ..typing import PADDING_ID
@@ -90,6 +92,7 @@ class SubgraphEngine:
                   if options.with_labels else None)
         self._labels = None if labels is None else np.asarray(labels)
         self._samplers: Dict[int, NeighborSampler] = {}
+        self._owner_registered: set = set()
         self._lock = threading.Lock()
 
     # -- request validation -------------------------------------------------
@@ -172,10 +175,19 @@ class SubgraphEngine:
             seeds[off: off + s.size] = s
             off += s.size
         sampler = self._sampler(bucket)
-        out = sampler.sample_from_nodes(NodeSamplerInput(seeds))
-        x = None
-        if self._feature is not None:
-            x = self._feature.gather(out.node)
+        # Each bucket compiles once; any further compilation under this
+        # label is bucket churn — the storm compilewatch exists to catch.
+        with _compilewatch.label(f"serving_bucket_{bucket}"):
+            out = sampler.sample_from_nodes(NodeSamplerInput(seeds))
+            x = None
+            if self._feature is not None:
+                x = self._feature.gather(out.node)
+        if bucket not in self._owner_registered:
+            # First micro-batch per bucket: claim the sample-buffer
+            # fingerprints so the device census attributes them to us.
+            for arr in (out.node, out.row, out.col):
+                _device.register_owner("serving", array=arr)
+            self._owner_registered.add(bucket)
         node, row, col, edge, edge_mask, x_h = jax.device_get(
             (out.node, out.row, out.col, out.edge, out.edge_mask, x))
         y = None

@@ -41,6 +41,7 @@ import numpy as np
 
 from ..obs import flight as _flight
 from ..obs import metrics as _metrics
+from ..obs import profiler as _profiler
 from ..obs.trace import span as _span
 from .engine import SubgraphEngine
 from .errors import DeadlineExceeded, Overloaded, ServingDown, ServingError
@@ -200,6 +201,12 @@ class ServingFront:
             self._shed_slo = alert.get("slo")
             _flight.record("serving.shed_on", slo=self._shed_slo,
                            shed_frac=self._shed_frac)
+            # One bounded profiler capture per firing (rate-limited
+            # inside the profiler; no-op unless armed): the trace of
+            # the incident, taken while it is happening.
+            prof = _profiler.armed()
+            if prof is not None:
+                prof.trigger("slo:" + str(self._shed_slo))
         else:
             _flight.record("serving.shed_off", slo=alert.get("slo"))
             self._shed_frac = 0.0

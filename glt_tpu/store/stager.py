@@ -44,6 +44,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs import device as _device
 from ..obs import flight as _flight
 from ..obs import metrics as _metrics
 from .disk import DiskFeatureStore
@@ -74,6 +75,9 @@ class DramStager:
         # THE feature-byte allocation — never grown (the enforced budget).
         self._buf = np.empty((self.capacity, store.dim), store.dtype)
         assert self._buf.nbytes <= self.dram_budget_bytes
+        # Any device-resident copy of a staged block carries this
+        # fingerprint; the device census then attributes it to us.
+        _device.register_owner("stager", array=self._buf)
         # Residency metadata (out of budget, documented): store row ->
         # slot, slot -> store row, slot -> score, row -> access frequency.
         self._slot_of = np.full(store.num_rows, -1, np.int64)

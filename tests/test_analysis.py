@@ -1089,6 +1089,143 @@ class TestWallClockDuration:
 
 
 # ---------------------------------------------------------------------------
+# GLT016 unbalanced-profiler-capture
+# ---------------------------------------------------------------------------
+
+class TestUnbalancedProfilerCapture:
+    def test_positive_bare_start(self):
+        src = """
+        import jax
+
+        def profile_epoch(run, d):
+            jax.profiler.start_trace(d)
+            run()
+            jax.profiler.stop_trace()
+        """
+        fs = findings_for(src, "unbalanced-profiler-capture")
+        assert len(fs) == 1
+        assert fs[0].code == "GLT016"
+        assert "finally" in fs[0].message
+
+    def test_positive_stop_only_in_except(self):
+        # stop in an except handler doesn't run on the success path's
+        # early return, and isn't the balanced shape.
+        src = """
+        import jax
+
+        def profile_epoch(run, d):
+            jax.profiler.start_trace(d)
+            try:
+                run()
+            except ValueError:
+                jax.profiler.stop_trace()
+        """
+        assert len(findings_for(src, "unbalanced-profiler-capture")) == 1
+
+    def test_negative_start_then_try_finally(self):
+        # The contextmanager idiom (obs/profiler.py capture()): start
+        # BEFORE the try, stop in its finally.
+        src = """
+        import jax
+
+        def profile_epoch(run, d):
+            jax.profiler.start_trace(d)
+            try:
+                run()
+            finally:
+                jax.profiler.stop_trace()
+        """
+        assert findings_for(src, "unbalanced-profiler-capture") == []
+
+    def test_negative_start_inside_try(self):
+        src = """
+        import jax
+
+        def profile_epoch(run, d):
+            try:
+                jax.profiler.start_trace(d)
+                run()
+            finally:
+                jax.profiler.stop_trace()
+        """
+        assert findings_for(src, "unbalanced-profiler-capture") == []
+
+    def test_negative_alias_import(self):
+        src = """
+        from jax import profiler as _jprof
+
+        def profile_epoch(run, d):
+            _jprof.start_trace(d)
+            try:
+                run()
+            finally:
+                _jprof.stop_trace()
+        """
+        assert findings_for(src, "unbalanced-profiler-capture") == []
+
+    def test_positive_alias_unbalanced(self):
+        src = """
+        from jax import profiler as _jprof
+
+        def profile_epoch(run, d):
+            _jprof.start_trace(d)
+            run()
+        """
+        assert len(findings_for(src, "unbalanced-profiler-capture")) == 1
+
+    def test_positive_start_server(self):
+        src = """
+        import jax
+
+        def serve(port):
+            jax.profiler.start_server(port)
+            work()
+        """
+        fs = findings_for(src, "unbalanced-profiler-capture")
+        assert len(fs) == 1
+        assert "stop_server" in fs[0].message
+
+    def test_negative_capture_ctx(self):
+        # The blessed wrapper: no raw start/stop at all.
+        src = """
+        from glt_tpu.obs import profiler as obs_profiler
+
+        def profile_epoch(run, d):
+            with obs_profiler.capture(d, millis=50):
+                run()
+        """
+        assert findings_for(src, "unbalanced-profiler-capture") == []
+
+    def test_nested_scopes_independent(self):
+        # The balanced inner function must not excuse the module-level
+        # bare start.
+        src = """
+        import jax
+
+        jax.profiler.start_trace("/tmp/t")
+
+        def ok(run, d):
+            jax.profiler.start_trace(d)
+            try:
+                run()
+            finally:
+                jax.profiler.stop_trace()
+        """
+        assert len(findings_for(src, "unbalanced-profiler-capture")) == 1
+
+    def test_suppression(self):
+        src = """
+        import jax
+
+        def repl_start(d):
+            # interactive notebook seam: the user stops it by hand
+            # gltlint: disable-next=unbalanced-profiler-capture
+            jax.profiler.start_trace(d)
+        """
+        assert findings_for(src, "unbalanced-profiler-capture") == []
+
+
+# ---------------------------------------------------------------------------
 # the project engine: symbols, call graph, effects
 # ---------------------------------------------------------------------------
 
@@ -1701,6 +1838,7 @@ def test_rule_registry_complete():
         "span-in-traced-code", "non-atomic-state-publish",
         "unbounded-queue-put", "dispatch-in-epoch-loop",
         "blocking-io-in-epoch-loop", "wall-clock-duration",
+        "unbalanced-profiler-capture",
     }
 
 

@@ -529,6 +529,43 @@ class TestRoofline:
         assert obs.roofline_fraction(50.0, 100.0) == pytest.approx(0.5)
         assert obs.roofline_fraction(1.0, 0.0) > 0   # guarded divide
 
+    def test_peak_hbm_env_override(self, monkeypatch):
+        from glt_tpu.obs.roofline import peak_hbm_gb_s
+
+        monkeypatch.setenv("GLT_HBM_GBPS", "1228")
+        r = peak_hbm_gb_s()
+        assert r == {"gb_s": 1228.0, "source": "env"}
+
+    def test_peak_hbm_bad_env_falls_through(self, monkeypatch):
+        from glt_tpu.obs.roofline import peak_hbm_gb_s
+
+        monkeypatch.setenv("GLT_HBM_GBPS", "not-a-number")
+        r = peak_hbm_gb_s()
+        assert r["source"] != "env"
+        assert r["gb_s"] > 0
+
+    def test_peak_hbm_resolves_without_env(self, monkeypatch):
+        # On CPU the device_kind table has no row -> conservative v5e
+        # default; on a real TPU the kind resolves.  Either way: a
+        # positive number with a named source, never an exception.
+        from glt_tpu.obs.roofline import DEFAULT_HBM_GB_S, peak_hbm_gb_s
+
+        monkeypatch.delenv("GLT_HBM_GBPS", raising=False)
+        r = peak_hbm_gb_s()
+        assert r["gb_s"] > 0
+        assert r["source"].startswith("device_kind:") \
+            or r["source"] == "default_v5e"
+        if r["source"] == "default_v5e":
+            assert r["gb_s"] == DEFAULT_HBM_GB_S
+
+    def test_peak_hbm_device_kind_table(self):
+        from glt_tpu.obs.roofline import DEVICE_HBM_GB_S
+
+        table = dict(DEVICE_HBM_GB_S)
+        assert table["v5e"] == 819.0
+        assert table["v5p"] > table["v5e"]        # newer gen is faster
+        assert table["v6e"] > table["v5e"]
+
 
 # ---------------------------------------------------------------------------
 # loader metrics (end to end through NodeLoader)

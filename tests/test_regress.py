@@ -66,6 +66,17 @@ class TestDirections:
         op, target = ASPIRATIONS["serving_p99_ms"]
         assert op == "<="
 
+    def test_device_telemetry_directions(self):
+        # ISSUE 14: peak HBM is informational (shape-dependent), the
+        # steady-state compile count tracks DOWN with a == 0 target.
+        assert direction("hbm_peak_bytes") == NEUTRAL
+        assert direction("hbm_bw_gb_s") == NEUTRAL
+        assert direction("hbm_fraction_measured") == UP
+        assert direction("compile_count_epoch") == DOWN
+        from glt_tpu.obs.regress import ASPIRATIONS
+        op, target = ASPIRATIONS["compile_count_epoch"]
+        assert op == "<=" and target == 0.0
+
 
 class TestCompare:
     def test_regression_flagged_beyond_threshold(self):
@@ -105,6 +116,42 @@ class TestCompare:
         (row,) = [r for r in rep["rows"]
                   if r["metric"] == "tunnel_rtt_ms"]
         assert row["status"] == "info"
+
+    def test_neutral_ceiling_hbm_peak(self):
+        # NEUTRAL normally never verdicts, but a capacity ceiling is
+        # absolute: peak HBM past the device limit is a regression no
+        # matter which direction "better" points.
+        from glt_tpu.obs.regress import CEILINGS
+
+        cap = CEILINGS["hbm_peak_bytes"]
+        assert cap == 16 * 2**30
+        under = [("r1", {"hbm_peak_bytes": cap * 0.5}),
+                 ("fresh", {"hbm_peak_bytes": cap * 0.9})]
+        rep = compare(under)
+        (row,) = [r for r in rep["rows"]
+                  if r["metric"] == "hbm_peak_bytes"]
+        assert row["status"] == "info"
+        over = [("r1", {"hbm_peak_bytes": cap * 0.5}),
+                ("fresh", {"hbm_peak_bytes": cap * 1.1})]
+        rep = compare(over)
+        (row,) = [r for r in rep["rows"]
+                  if r["metric"] == "hbm_peak_bytes"]
+        assert row["status"] == "regress"
+        assert row["ceiling"] == cap
+        assert "hbm_peak_bytes" in rep["regressions"]
+        assert rep["verdict"] == "regress"
+
+    def test_compile_count_flat_nonzero_is_stuck(self):
+        # The <= 0 aspiration: a steady-state loop that keeps
+        # compiling a little every epoch is flat AND unmet -> stuck.
+        flat = [("r1", {"compile_count_epoch": 3.0}),
+                ("r2", {"compile_count_epoch": 3.0}),
+                ("fresh", {"compile_count_epoch": 3.0})]
+        assert compare(flat)["stuck"] == ["compile_count_epoch"]
+        met = [("r1", {"compile_count_epoch": 0.0}),
+               ("r2", {"compile_count_epoch": 0.0}),
+               ("fresh", {"compile_count_epoch": 0.0})]
+        assert compare(met)["stuck"] == []
 
     def test_stuck_requires_flat_and_unmet_target(self):
         # best_step_ms carries the headline aspiration (<= 40 ms) the

@@ -604,6 +604,90 @@ def test_new_client_flight_dump_against_old_server():
         t.join(timeout=10)
 
 
+def test_profile_capture_wire_op(server, tmp_path):
+    """ISSUE 14: ``profile_capture`` runs a bounded jax.profiler trace
+    on the SERVER host and returns the capture dir — a real trace lands
+    on disk, and the server's flight ring indexes the incident."""
+    from glt_tpu.distributed.dist_client import RemoteServerConnection
+
+    conn = RemoteServerConnection(server.addr)
+    cap_dir = str(tmp_path / "srv_capture")
+    try:
+        resp = conn.profile_capture(dir=cap_dir, millis=10.0)
+        assert resp is not None and resp["ok"]
+        assert resp["dir"] == cap_dir
+        # Real capture artifacts, not just a polite reply.
+        files = [os.path.join(root, f)
+                 for root, _, fs in os.walk(cap_dir) for f in fs]
+        assert any(f.endswith(".xplane.pb") for f in files), files
+        # Indexed in the server's black box.
+        snap = conn.flight_dump()
+        kinds = [e["kind"] for e in snap["events"]]
+        assert "server.profile_capture_served" in kinds
+        assert "profiler.capture" in kinds
+    finally:
+        conn.close()
+
+
+def test_old_client_profile_capture_against_new_server(server, tmp_path):
+    """Mixed-version (ISSUE 14 satellite): an operator's plain-JSON
+    poke — no helper, no #trace — gets the capture dir back as ordinary
+    JSON: nothing about triggered profiling requires a new client."""
+    from glt_tpu.distributed.dist_server import (_KIND_JSON, recv_frame,
+                                                 send_frame)
+
+    cap_dir = str(tmp_path / "poke_capture")
+    raw = socket.create_connection(server.addr, timeout=10)
+    raw.settimeout(30)
+    try:
+        send_frame(raw, _KIND_JSON, json.dumps(
+            {"op": "profile_capture", "dir": cap_dir,
+             "millis": 10.0}).encode())
+        kind, data = recv_frame(raw)
+        assert kind == _KIND_JSON
+        resp = json.loads(data)
+        assert resp["ok"] and resp["dir"] == cap_dir
+        assert "#trace" not in resp
+        assert os.path.isdir(cap_dir)
+    finally:
+        raw.close()
+
+
+def test_new_client_profile_capture_against_old_server():
+    """Mixed-version (ISSUE 14 satellite): a pre-14 server answers the
+    unknown op with its structured fatal error and closes — the client
+    helper degrades to None ("no capture available"), never a raised
+    failure mode on the incident path."""
+    from glt_tpu.distributed.dist_client import RemoteServerConnection
+    from glt_tpu.distributed.dist_server import (_KIND_JSON, recv_frame,
+                                                 send_frame)
+
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+
+    def old_server():
+        conn, _ = listener.accept()
+        with conn:
+            kind, data = recv_frame(conn)
+            op = json.loads(data)["op"]
+            # pre-14 _handle: unknown op -> fatal error, then close.
+            send_frame(conn, _KIND_JSON, json.dumps(
+                {"error": f"unknown op {op!r}", "code": "fatal"}).encode())
+
+    t = threading.Thread(target=old_server, daemon=True)
+    t.start()
+    conn = RemoteServerConnection(listener.getsockname())
+    try:
+        assert conn.profile_capture(millis=10.0) is None
+        assert conn.broken        # reconnects on next use
+    finally:
+        conn.close()
+        listener.close()
+        t.join(timeout=10)
+
+
 def test_two_clients_same_server(server):
     l1 = RemoteNeighborLoader(server.addr, [2], np.arange(0, 12),
                               batch_size=6)
