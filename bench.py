@@ -721,6 +721,120 @@ def main():
         "gather_kernel_choice": kernel_choice,
     }))
 
+    # --- sampling-wall sweep (ISSUE 15): degree-binned Pallas sampling
+    # vs XLA.  autotune_sample runs at each hop's EXACT (width, fanout)
+    # shape for both samplers this bench uses (full + occupancy-capped —
+    # the day-one exact-shape keying; a capped hop never inherits the
+    # full-cap winner), then the full multi-hop program is A/B-timed
+    # with the neighbor-read seam pinned each way.  Off-TPU the sweep
+    # pins 'xla' (empty ms maps, the table still records the exact-shape
+    # keys) and the pallas side of the A/B is omitted — a CPU run's
+    # numbers stay honest rather than flattering.
+    _progress("sampling kernel sweep (degree-binned pallas vs xla)")
+    from glt_tpu.obs import compilewatch as obs_compilewatch
+    from glt_tpu.ops.sample_pallas import (
+        autotune_sample,
+        sample_autotune_table,
+    )
+
+    sample_kernel_choice = "xla"
+    for smp in (tsampler, csampler):
+        for w_hop, f_hop in zip(smp._widths, smp.num_neighbors):
+            probe = jnp.arange(int(w_hop), dtype=jnp.int32) % n
+            ch = autotune_sample(graph.indptr, graph.indices, probe,
+                                 int(f_hop), with_edge=smp.with_edge)
+            if ch == "pallas":
+                sample_kernel_choice = "pallas"
+    sample_autotune = sample_autotune_table()
+
+    def time_forced_sampler(force):
+        sv = NeighborSampler(graph, FANOUT, batch_size=BATCH, seed=0,
+                             with_edge=False, frontier_cap=fcap,
+                             sample_force=force)
+
+        def go(i):
+            return sv._sample_jit(graph.indptr, graph.indices,
+                                  graph.gather_edge_ids,
+                                  batches[(WARMUP + i) % len(batches)],
+                                  jax.random.fold_in(base, 700 + i))
+
+        tot = jnp.zeros((), jnp.int32)
+        tot = acc_edges(tot, go(0).num_sampled_edges)   # warm compile
+        sync(tot)
+        tot = jnp.zeros((), jnp.int32)
+        t0 = time.perf_counter()
+        for i in range(t_iters):
+            tot = acc_edges(tot, go(i).num_sampled_edges)
+        sync(tot)
+        return (time.perf_counter() - t0) / t_iters * 1e3
+
+    t_samp_xla = time_forced_sampler("xla")
+    t_samp_pal = None
+    if jax.default_backend() == "tpu":
+        try:
+            with obs_compilewatch.label("sample_pallas_ab"):
+                t_samp_pal = time_forced_sampler("pallas")
+        except Exception as e:  # noqa: BLE001 - kernel unsupported on chip
+            _progress(f"pallas sampling A/B failed ({e!r}); xla only")
+    # Delivered-fraction-of-memcpy for the sample stage under each
+    # kernel (attrib.py's expected-bytes floor over the measured time).
+    samp_bytes = attrib.sample_expected_bytes(BATCH, FANOUT)
+
+    def _samp_frac(ms):
+        return (samp_bytes / (ms * 1e-3) / 1e9) / max(
+            memcpy_roofline_gb_s, 1e-9)
+
+    _PARTIAL.update(prune_unmeasured({
+        "sample_ms_xla": _round(t_samp_xla, 3),
+        "sample_ms_pallas": _round(t_samp_pal, 3),
+        "sample_kernel_choice": sample_kernel_choice,
+        "sample_roofline_frac_xla": _round(_samp_frac(t_samp_xla), 4),
+        "sample_roofline_frac_pallas": _round(
+            None if t_samp_pal is None else _samp_frac(t_samp_pal), 4),
+        "sample_autotune": sample_autotune,
+    }))
+
+    # --- fused frontier kernel A/B (ISSUE 15 tentpole, part 2): the
+    # one-dispatch dedup+gather vs the two-pass unfused path on the SAME
+    # capped sampled node list at d=128 (the kernel's native width — the
+    # bench feature dim pads up exactly like the gather sweep above).
+    # TPU-only: on CPU force='auto' resolves to the unfused fallback, so
+    # the A/B would time the same program twice.
+    fused_frontier_ms = fused_unfused_ms = None
+    if jax.default_backend() == "tpu":
+        from glt_tpu.ops.dedup_gather import dedup_gather_rows
+        from glt_tpu.ops.fused_frontier import (
+            fused_frontier,
+            fused_frontier_supported,
+        )
+
+        fids = gouts[0].node.astype(jnp.int32)
+        if fused_frontier_supported(hot128, fids):
+            try:
+                with obs_compilewatch.label(
+                        f"fused_frontier_u{int(fids.shape[0])}"):
+                    ffj = jax.jit(lambda t, i: fused_frontier(
+                        t, i, force="pallas").features)
+                    dgj = jax.jit(lambda t, i: dedup_gather_rows(t, i))
+
+                    def _time_ff(fn):
+                        sync(fn(hot128, fids)[0, 0])    # warm compile
+                        t0 = time.perf_counter()
+                        for _ in range(t_iters):
+                            out = fn(hot128, fids)
+                        sync(out[0, 0])
+                        return ((time.perf_counter() - t0)
+                                / t_iters * 1e3)
+
+                    fused_frontier_ms = _time_ff(ffj)
+                    fused_unfused_ms = _time_ff(dgj)
+            except Exception as e:  # noqa: BLE001 - unsupported on chip
+                _progress(f"fused frontier A/B failed ({e!r})")
+    _PARTIAL.update(prune_unmeasured({
+        "fused_frontier_ms": _round(fused_frontier_ms, 3),
+        "fused_frontier_ms_unfused": _round(fused_unfused_ms, 3),
+    }))
+
     # --- MEASURED config-1 epochs (VERDICT r4 #2): the exact
     # examples/train_sage_products.py pipeline — 240 batches of 1024
     # (10% of 2.45M products nodes).  Two epoch drivers remain after the
@@ -801,11 +915,40 @@ def main():
     _PARTIAL["epoch_s_config1_scanned"] = round(epoch_scanned_s, 2)
     _PARTIAL["scanned_group"] = Gn
 
-    # The headline step: per-batch cost of the winning epoch driver.
+    # Fused-frontier scanned epoch: the same G-scan with the in-scan
+    # feature gather routed through the one-dispatch dedup+gather kernel.
+    # Timed only where the kernel actually engages (TPU + 128-multiple
+    # feature width) — elsewhere 'auto' resolves to the unfused fallback
+    # and the timing would re-measure the scanned epoch under a new name.
+    scanned_fused_step_ms = None
+    if jax.default_backend() == "tpu" and dim % 128 == 0:
+        sstep_f = make_scanned_node_train_step(
+            model_bf16, tx, csampler, feat, labels, BATCH,
+            fused_frontier="auto")
+        st3, ls, _, _ = sstep_f(state0, jnp.asarray(blocks[0]),
+                                jax.random.fold_in(base, 420))  # warm 1
+        st3, ls, _, _ = sstep_f(st3, jnp.asarray(blocks[0]),
+                                jax.random.fold_in(base, 421))  # warm 2
+        sync(ls[-1])
+        t0 = time.perf_counter()
+        st3 = state0
+        for i, blk in enumerate(blocks):
+            st3, ls, _, _ = sstep_f(st3, jnp.asarray(blk),
+                                    jax.random.fold_in(base, 600 + i))
+        sync(ls[-1])
+        scanned_fused_step_ms = ((time.perf_counter() - t0)
+                                 / n_epoch_batches * 1e3)
+        _PARTIAL["scanned_fused_step_ms"] = round(scanned_fused_step_ms, 2)
+
+    # The headline step: per-batch cost of the winning epoch driver
+    # (serial two-program, fused scan, or fused scan + fused frontier).
     scanned_step_ms = epoch_scanned_s / n_epoch_batches * 1e3
-    best_step_ms = min(capped["serial_step_ms"], scanned_step_ms)
-    best_path = ("scanned" if scanned_step_ms
-                 <= capped["serial_step_ms"] else "serial")
+    step_candidates = {"serial": capped["serial_step_ms"],
+                       "scanned": scanned_step_ms}
+    if scanned_fused_step_ms is not None:
+        step_candidates["scanned_fused"] = scanned_fused_step_ms
+    best_path = min(step_candidates, key=step_candidates.get)
+    best_step_ms = step_candidates[best_path]
 
     # --- distributed path on THIS chip (VERDICT r4 #6): the shard_map
     # sampler + fused dist train step on a 1-device mesh.  The collectives
@@ -1039,6 +1182,21 @@ def main():
         # Per-(width, batch, tile, ring) sweep landscape of the tiled
         # kernel (None off-TPU; see ops/gather_pallas.autotune_table).
         "gather_autotune": gather_autotune,
+        # Sampling-wall A/B (ISSUE 15): the multi-hop program with the
+        # neighbor-read seam pinned each way, the per-hop exact-shape
+        # sweep landscape, and delivered-fraction-of-memcpy under each
+        # kernel.  Pallas-side keys are omitted off-TPU (honest xla win).
+        "sample_ms_xla": _round(t_samp_xla, 3),
+        "sample_ms_pallas": _round(t_samp_pal, 3),
+        "sample_kernel_choice": sample_kernel_choice,
+        "sample_roofline_frac_xla": _round(_samp_frac(t_samp_xla), 4),
+        "sample_roofline_frac_pallas": _round(
+            None if t_samp_pal is None else _samp_frac(t_samp_pal), 4),
+        "sample_autotune": sample_autotune,
+        # One-dispatch dedup+gather vs the two-pass unfused path on the
+        # same capped node list at d=128 (TPU only).
+        "fused_frontier_ms": _round(fused_frontier_ms, 3),
+        "fused_frontier_ms_unfused": _round(fused_unfused_ms, 3),
         "train_ms": round(full["train_ms"], 2),
         "serial_step_ms": round(full["serial_step_ms"], 2),
         "train_step_tflops": round(tflops(cap, full["train_ms"]), 2),
@@ -1060,6 +1218,7 @@ def main():
         # Steady-state per-batch cost of the fused scanned epoch — the
         # headline step contender after the overlapped path's deletion.
         "scanned_step_ms": round(scanned_step_ms, 2),
+        "scanned_fused_step_ms": _round(scanned_fused_step_ms, 2),
         "best_step_path": best_path,
         "best_step_ms": round(best_step_ms, 2),
         "sampling_overhead_frac": round(

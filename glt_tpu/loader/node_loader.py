@@ -16,6 +16,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Iterator, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -86,6 +87,7 @@ class NodeLoader:
         self.overflow_fallback = bool(overflow_fallback)
         self.overflow_batches = 0
         self._autotune_row_gather()
+        self._autotune_sample()
 
     def _autotune_row_gather(self) -> None:
         """Warmup sweep of the row-gather kernel (XLA vs the tiled-DMA
@@ -109,6 +111,36 @@ class NodeLoader:
         # one cached row and flatter whichever path wins on latency.
         probe = jnp.arange(int(cap), dtype=jnp.int32) % max(feat.size, 1)
         autotune_gather_rows(feat.hot_rows, probe)
+
+    def _autotune_sample(self) -> None:
+        """Warmup sweep of the neighbor-sampling kernel (XLA vs the
+        degree-binned Pallas (tile_rows, ring_depth, bin_edges) grid),
+        one sweep per hop at that hop's **exact** frontier (width,
+        fanout) — ``sample_neighbors(force='auto')`` inside the
+        sampler's jitted programs then serves each hop with its measured
+        winner.  Same exact-shape discipline as ``_autotune_row_gather``
+        (a capped hop width is its own key, never the full-cap
+        winner's).  No-op off TPU — ``autotune_sample`` pins 'xla'
+        there, so CPU runs resolve the seam honestly — and for samplers
+        without the hop-width protocol."""
+        sampler = self.sampler
+        graph = getattr(sampler, "graph", None)
+        widths = getattr(sampler, "_widths", None)
+        fanouts = getattr(sampler, "num_neighbors", None)
+        if graph is None or widths is None or not fanouts:
+            return
+        if jax.default_backend() != "tpu":
+            return
+        from ..ops.sample_pallas import autotune_sample
+
+        nn = max(int(graph.num_nodes), 1)
+        for w, f in zip(widths, fanouts):
+            # Probe seeds spread across the graph so per-bin occupancy
+            # reflects the real degree distribution, not one hot row.
+            probe = jnp.arange(int(w), dtype=jnp.int32) % nn
+            autotune_sample(graph.indptr, graph.indices, probe, int(f),
+                            edge_ids=graph.gather_edge_ids,
+                            with_edge=getattr(sampler, "with_edge", True))
 
     def __len__(self) -> int:
         n = self.input_nodes.shape[0]
@@ -278,12 +310,14 @@ class NeighborLoader(NodeLoader):
         last_hop_dedup: bool = True,
         node_capacity: Optional[int] = None,
         overflow_fallback: bool = True,
+        sample_force: str = "auto",
     ):
         if sampler is None:
             sampler = NeighborSampler(
                 data.get_graph(), num_neighbors, batch_size=batch_size,
                 frontier_cap=frontier_cap, with_edge=with_edge, seed=seed,
-                last_hop_dedup=last_hop_dedup, node_capacity=node_capacity)
+                last_hop_dedup=last_hop_dedup, node_capacity=node_capacity,
+                sample_force=sample_force)
         super().__init__(data, sampler, input_nodes, batch_size=batch_size,
                          shuffle=shuffle, drop_last=drop_last,
                          prefetch=prefetch, seed=seed,

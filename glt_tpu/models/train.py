@@ -104,7 +104,7 @@ def make_train_step(model, tx, batch_size: int,
 
 
 def make_gather_xy(id2index=None, dedup: bool = False,
-                   force: str = "auto"):
+                   force: str = "auto", fused: str = "off"):
     """Pure ``(rows, labels, out) -> (x, y)`` batch gather.
 
     Feature rows and labels ride as arguments (not closures) so callers
@@ -117,16 +117,24 @@ def make_gather_xy(id2index=None, dedup: bool = False,
     :func:`~glt_tpu.ops.dedup_gather.dedup_gather_rows`) — the win when
     the node list repeats ids (un-deduped leaf hops, hub nodes).
     ``force`` selects the row-gather kernel
-    (:func:`~glt_tpu.ops.gather_pallas.gather_rows`).
+    (:func:`~glt_tpu.ops.gather_pallas.gather_rows`).  ``fused`` != 'off'
+    routes the whole dedup+gather through the one-dispatch
+    :func:`~glt_tpu.ops.fused_frontier.fused_frontier` kernel
+    ('auto'|'pallas'|'interpret'; same bits, unique rows never bounce
+    through HBM) — it subsumes ``dedup``.
     """
     from ..ops.dedup_gather import dedup_gather_rows
+    from ..ops.fused_frontier import fused_frontier
     from ..ops.gather_pallas import gather_rows
 
     def gather_xy(rows_arg, labels_arg, out):
         ids = out.node
         valid = ids >= 0
         gid = jnp.where(valid, ids, 0)
-        if dedup:
+        if fused != "off":
+            x = fused_frontier(rows_arg, ids, id2index=id2index,
+                               force=fused).features
+        elif dedup:
             x = dedup_gather_rows(rows_arg, ids, id2index=id2index,
                                   force=force)
         else:
@@ -199,7 +207,8 @@ def _check_cache(feature_cache, rows_dtype, dim):
 def make_scanned_node_train_step(model, tx, sampler, rows, labels,
                                  batch_size: int, dropout_seed: int = 0,
                                  dedup: bool = False, feature_cache=None,
-                                 gather_force: str = "auto"):
+                                 gather_force: str = "auto",
+                                 fused_frontier: str = "off"):
     """ONE jitted program trains ``G`` consecutive seed-node batches.
 
     The supervised-node analog of :func:`make_scanned_link_train_step`:
@@ -228,6 +237,15 @@ def make_scanned_node_train_step(model, tx, sampler, rows, labels,
     autotune_gather_rows` winner for this table/batch shape — autotune
     at the CAPPED shape before building the step so the fused gather
     runs the tile/ring point measured for its own batch size).
+    ``fused_frontier`` != 'off' routes the in-scan feature gather through
+    the one-dispatch sample->dedup->gather kernel
+    (:func:`~glt_tpu.ops.fused_frontier.fused_frontier`; bit-identical
+    ``x``, VMEM-overflowing frontiers fall back to the unfused path).
+    The cross-batch ``feature_cache`` wins when both are set — its
+    unique-pass bookkeeping IS the fusion's dedup half, so the fused
+    kernel only applies to the cache-less gather.  The kernel compiles
+    under the ``scanned_node_step`` compilewatch label like everything
+    else in the scan.
     """
     import numpy as np
 
@@ -245,7 +263,7 @@ def make_scanned_node_train_step(model, tx, sampler, rows, labels,
         cached_xy = make_cached_gather_xy(rows.id2index,
                                           force=gather_force)
     gather_xy = make_gather_xy(rows.id2index, dedup=dedup,
-                               force=gather_force)
+                               force=gather_force, fused=fused_frontier)
 
     @partial(jax.jit, donate_argnums=(6,))
     def run(indptr, indices, eids, rows_arg, labels_arg,

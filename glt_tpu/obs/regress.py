@@ -64,6 +64,19 @@ EXPLICIT_DIRECTIONS: Dict[str, int] = {
     "sample_roofline_frac": UP,
     "dedup_roofline_frac": UP,
     "train_roofline_frac": UP,
+    # Sampling-wall A/B (ISSUE 15, ops/sample_pallas.py +
+    # ops/fused_frontier.py): both sides of the neighbor-read kernel
+    # race track DOWN (the _xla/_pallas endings dodge the _ms suffix
+    # rule, so they are pinned here), each path's delivered fraction of
+    # memcpy tracks UP, and the one-dispatch dedup+gather must beat (or
+    # at least not lose ground to) its two-pass unfused twin.
+    "sample_ms_xla": DOWN,
+    "sample_ms_pallas": DOWN,
+    "sample_roofline_frac_xla": UP,
+    "sample_roofline_frac_pallas": UP,
+    "fused_frontier_ms": DOWN,
+    "fused_frontier_ms_unfused": DOWN,
+    "scanned_fused_step_ms": DOWN,
     "obs_disabled_overhead_frac": DOWN,
     "sampling_overhead_frac": DOWN,
     "sampling_overhead_frac_epoch": DOWN,
@@ -160,6 +173,10 @@ ASPIRATIONS: Dict[str, Tuple[str, float]] = {
     # Runtime recompile telemetry (ISSUE 14): a steady-state fused
     # epoch compiles nothing — any flat nonzero count is stuck.
     "compile_count_epoch": ("<=", 0.0),
+    # Sampling wall (ISSUE 15): the degree-binned kernel should deliver
+    # at least 30% of memcpy on the sample stage's expected-bytes floor
+    # — flat below that is stuck, exactly like the gather bar above.
+    "sample_roofline_frac_pallas": (">=", 0.3),
 }
 
 #: NEUTRAL-with-ceiling: metrics with no better/worse direction that

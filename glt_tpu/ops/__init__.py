@@ -1,4 +1,9 @@
 from .dedup_gather import dedup_counts, dedup_gather_rows
+from .fused_frontier import (
+    FusedFrontier,
+    fused_frontier,
+    fused_frontier_supported,
+)
 from .gather_pallas import (
     autotune_gather_rows,
     autotune_table,
@@ -7,6 +12,11 @@ from .gather_pallas import (
 )
 from .neighbor_sample import NeighborOutput, lookup_degrees, sample_neighbors
 from .negative_sample import NegativeSampleOutput, edge_in_csr, sample_negative_edges
+from .sample_pallas import (
+    autotune_sample,
+    sample_autotune_table,
+    sample_neighbors_pallas,
+)
 from .stitch import stitch_sample_results
 from .subgraph import SubGraphOutput, node_subgraph
 from .unique import UniqueResult, relabel_by_reference, unique_first_occurrence
@@ -19,4 +29,6 @@ __all__ = [
     "UniqueResult", "relabel_by_reference", "unique_first_occurrence",
     "dedup_counts", "dedup_gather_rows",
     "autotune_gather_rows", "autotune_table", "gather_rows", "gather_rows_pallas",
+    "autotune_sample", "sample_autotune_table", "sample_neighbors_pallas",
+    "FusedFrontier", "fused_frontier", "fused_frontier_supported",
 ]

@@ -18,9 +18,25 @@ On TPU we avoid both the O(degree) reservoir walk and the dynamic output:
 
 All functions are pure and shard_map-compatible: inputs/outputs are plain
 arrays, no host syncs.
+
+**The A/B seam** (the gather_pallas.py pattern applied to sampling):
+``sample_neighbors(force=...)`` routes the memory-bound half of the hop
+— the ``indices[start + pos]`` / ``edge_ids[start + pos]`` random reads
+— through either XLA's generic gather or the degree-binned Pallas DMA
+kernel (:mod:`.sample_pallas`).  The *draw* (Floyd / with-replacement
+positions) always runs here in XLA: pltpu's PRNG is not threefry-bit-
+compatible with jax.random, and bit-identical output across paths is
+what lets every existing sampler/loader/dist test double as a
+correctness oracle.  ``force='auto'`` serves the winner memoized by
+:func:`~glt_tpu.ops.sample_pallas.autotune_sample` per exact
+(batch, fanout, dtype) key — XLA until a measurement exists.  The
+``GLT_SAMPLE_FORCE`` env var overrides (``pallas``/``xla``/
+``interpret`` — the last runs the Pallas path in interpret mode so the
+seam is exercisable end to end on CPU).
 """
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -46,6 +62,43 @@ def _row_offsets_and_degrees(indptr, seeds):
     return start, deg.astype(jnp.int32)
 
 
+def _draw_positions(deg: jnp.ndarray, fanout: int, key: jax.Array,
+                    with_replacement: bool):
+    """Per-row draw positions + validity mask: ``(pos [B, fanout],
+    mask [B, fanout])`` with ``pos[i, k] < max(deg[i], 1)``.
+
+    Shared by the XLA and Pallas sampling paths — the draw is the
+    bit-identity anchor between them (both gather ``indices[start +
+    where(mask, pos, 0)]``), so it must run through jax.random on both.
+    """
+    b = deg.shape[0]
+    slot_ids = jnp.arange(fanout, dtype=jnp.int32)  # [k]
+
+    if with_replacement:
+        pos = jax.random.randint(
+            key, (b, fanout), 0, jnp.maximum(deg, 1)[:, None], dtype=jnp.int32
+        )
+        mask = (slot_ids[None, :] < jnp.where(deg > 0, fanout, 0)[:, None])
+        return pos, mask
+
+    # Floyd's uniform k-subset algorithm, unrolled over the (static,
+    # small) fanout.  For rows with deg <= fanout we take slots 0..deg-1
+    # directly; Floyd only engages when deg > fanout.
+    chosen = jnp.full((b, fanout), -1, jnp.int32)
+    keys = jax.random.split(key, fanout)
+    for i in range(fanout):
+        j = deg - fanout + i                       # [B], >= 0 when deg > fanout
+        t = jax.random.randint(
+            keys[i], (b,), 0, jnp.maximum(j + 1, 1), dtype=jnp.int32
+        )
+        dup = jnp.any(chosen == t[:, None], axis=1)
+        floyd_pos = jnp.where(dup, j, t)
+        pos_i = jnp.where(deg > fanout, floyd_pos, i)
+        chosen = chosen.at[:, i].set(pos_i)
+    mask = slot_ids[None, :] < jnp.minimum(deg, fanout)[:, None]
+    return chosen, mask
+
+
 def sample_neighbors(
     indptr: jnp.ndarray,
     indices: jnp.ndarray,
@@ -55,6 +108,7 @@ def sample_neighbors(
     edge_ids: Optional[jnp.ndarray] = None,
     with_replacement: bool = False,
     with_edge: bool = True,
+    force: str = "auto",
 ) -> NeighborOutput:
     """Sample up to ``fanout`` neighbors per seed from a CSR graph.
 
@@ -73,6 +127,9 @@ def sample_neighbors(
         (``eids`` is None) — saves one random gather over the edge array
         per hop, the dominant cost at wide frontiers (the reference's
         ``Sample`` vs ``SampleWithEdge`` split, random_sampler.cu:267,310).
+      force: neighbor-read kernel seam — 'auto' | 'pallas' | 'xla' |
+        'interpret' (see module docstring).  ``GLT_SAMPLE_FORCE``
+        overrides.
 
     Returns:
       :class:`NeighborOutput` with static ``[B, fanout]`` arrays.  Rows with
@@ -81,36 +138,23 @@ def sample_neighbors(
     """
     if fanout <= 0:
         raise ValueError(f"fanout must be positive, got {fanout}")
+    env = os.environ.get("GLT_SAMPLE_FORCE")
+    if env in ("pallas", "xla", "interpret"):
+        force = env
     seeds = seeds.astype(jnp.int32)
-    b = seeds.shape[0]
+    if force != "xla":
+        # Lazy import: sample_pallas imports the draw/offset helpers
+        # from this module.
+        from . import sample_pallas as _sp
+
+        params = _sp.auto_params(seeds.shape[0], fanout, indices.dtype)
+        if force in ("pallas", "interpret") or params is not None:
+            return _sp.sample_neighbors_pallas(
+                indptr, indices, seeds, fanout, key, edge_ids=edge_ids,
+                with_replacement=with_replacement, with_edge=with_edge,
+                params=params, interpret=(force == "interpret"))
     start, deg = _row_offsets_and_degrees(indptr, seeds)
-
-    slot_ids = jnp.arange(fanout, dtype=jnp.int32)  # [k]
-
-    if with_replacement:
-        draws = jax.random.randint(
-            key, (b, fanout), 0, jnp.maximum(deg, 1)[:, None], dtype=jnp.int32
-        )
-        pos = draws
-        mask = (slot_ids[None, :] < jnp.where(deg > 0, fanout, 0)[:, None])
-    else:
-        # Floyd's uniform k-subset algorithm, unrolled over the (static,
-        # small) fanout.  For rows with deg <= fanout we take slots 0..deg-1
-        # directly; Floyd only engages when deg > fanout.
-        chosen = jnp.full((b, fanout), -1, jnp.int32)
-        keys = jax.random.split(key, fanout)
-        for i in range(fanout):
-            j = deg - fanout + i                       # [B], >= 0 when deg > fanout
-            t = jax.random.randint(
-                keys[i], (b,), 0, jnp.maximum(j + 1, 1), dtype=jnp.int32
-            )
-            dup = jnp.any(chosen == t[:, None], axis=1)
-            floyd_pos = jnp.where(dup, j, t)
-            pos_i = jnp.where(deg > fanout, floyd_pos, i)
-            chosen = chosen.at[:, i].set(pos_i)
-        pos = chosen
-        mask = slot_ids[None, :] < jnp.minimum(deg, fanout)[:, None]
-
+    pos, mask = _draw_positions(deg, fanout, key, with_replacement)
     flat = start[:, None] + jnp.where(mask, pos, 0)
     nbrs = jnp.where(mask, indices[flat], PADDING_ID).astype(jnp.int32)
     if not with_edge:

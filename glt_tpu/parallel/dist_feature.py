@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.fused_frontier import fused_frontier as _fused_frontier
 from ..ops.unique import unique_first_occurrence
 from .dist_sampler import Routing, _use_fused, build_routing
 
@@ -50,6 +51,26 @@ def _dedup_scatter_back_1d(uvals: jnp.ndarray, inv: jnp.ndarray
     """1-D analog of :func:`_dedup_scatter_back` (label columns)."""
     out = jnp.take(uvals, jnp.clip(inv, 0, inv.shape[0] - 1))
     return jnp.where(inv >= 0, out, 0)
+
+
+def _request_rows(rows: jnp.ndarray, local: jnp.ndarray, ok: jnp.ndarray,
+                  fused_frontier: str) -> jnp.ndarray:
+    """Serving-side row fetch of every exchange: rows for the id
+    requests landed on this shard (zeros where ``ok`` is False).
+
+    ``fused_frontier`` != 'off' serves the request block through the
+    one-dispatch dedup+gather kernel — the request list repeats hub rows
+    across requesting shards, and the fused path reads each distinct row
+    from HBM once, out of VMEM thereafter.  Bit-identical to the naive
+    take (valid ``local`` needs no clip; invalid positions are -1-masked
+    into the kernel's padding path, which zeroes them exactly like the
+    ``where``).
+    """
+    if fused_frontier != "off":
+        return _fused_frontier(rows, jnp.where(ok, local, -1),
+                               force=fused_frontier).features
+    got = jnp.take(rows, jnp.where(ok, local, 0), axis=0, mode="clip")
+    return jnp.where(ok[:, None], got, 0)
 
 
 def _exchange_ids(routing: Routing, num_shards: int, cap: int,
@@ -70,6 +91,7 @@ def exchange_gather(
     dedup: bool = False,
     routing: Optional[Routing] = None,
     route: str = "auto",
+    fused_frontier: str = "off",
 ) -> jnp.ndarray:
     """Gather feature rows for global ``ids`` across shards.
 
@@ -85,13 +107,16 @@ def exchange_gather(
         plan across the neighbor/feature/label exchanges of a frontier
         instead of re-bucketing per exchange.  Ignored under ``dedup``
         (the plan there is over the unique id list).
+      fused_frontier: serving-side kernel seam (see
+        :func:`_request_rows`); bit-identical either way.
 
     Returns: ``[B, d]`` rows in input order.
     """
     if dedup:
         uniq, inv, _ = unique_first_occurrence(ids)
         urows = exchange_gather(uniq, rows, nodes_per_shard, num_shards,
-                                axis_name, route=route)
+                                axis_name, route=route,
+                                fused_frontier=fused_frontier)
         return _dedup_scatter_back(urows, inv)
     b = ids.shape[0]
     d = rows.shape[-1]
@@ -103,8 +128,7 @@ def exchange_gather(
     my_rank = lax.axis_index(axis_name)
     local = requests - my_rank * nodes_per_shard
     ok = (local >= 0) & (local < nodes_per_shard) & (requests >= 0)
-    got = jnp.take(rows, jnp.where(ok, local, 0), axis=0, mode="clip")
-    got = jnp.where(ok[:, None], got, 0)
+    got = _request_rows(rows, local, ok, fused_frontier)
 
     resp = lax.all_to_all(
         got.reshape(num_shards, b, d), axis_name, 0, 0,
@@ -287,6 +311,7 @@ def exchange_gather_xy(
     routing: Optional[Routing] = None,
     route: str = "auto",
     fused: Optional[bool] = None,
+    fused_frontier: str = "off",
 ):
     """Feature AND label gather for one frontier in a single exchange.
 
@@ -315,6 +340,8 @@ def exchange_gather_xy(
         routing plan and id collective, paying one extra payload launch.
         Value-fusion also requires a float32 feature block (the bitcast
         target); other dtypes silently take the shared-routing split.
+      fused_frontier: serving-side kernel seam for the feature-row fetch
+        (see :func:`_request_rows`); bit-identical either way.
 
     Returns:
       ``(x [B, d], y [B] int32)`` in input order (zeros at invalid
@@ -326,7 +353,7 @@ def exchange_gather_xy(
             uniq, rows, labels_col, nodes_per_shard, num_shards,
             axis_name, hot_per_shard=hot_per_shard,
             staged_rows=staged_rows, staged_slots=staged_slots,
-            route=route, fused=fused)
+            route=route, fused=fused, fused_frontier=fused_frontier)
         return _dedup_scatter_back(ux, inv), _dedup_scatter_back_1d(uy, inv)
 
     b = ids.shape[0]
@@ -341,8 +368,7 @@ def exchange_gather_xy(
     h = nodes_per_shard if hot_per_shard is None else int(hot_per_shard)
     okx = (local >= 0) & (local < h) & (requests >= 0)
     oky = (local >= 0) & (local < nodes_per_shard) & (requests >= 0)
-    gotx = jnp.take(rows, jnp.where(okx, local, 0), axis=0, mode="clip")
-    gotx = jnp.where(okx[:, None], gotx, 0)
+    gotx = _request_rows(rows, local, okx, fused_frontier)
     if staged_rows is not None:
         idx = jnp.where(staged_slots >= 0, staged_slots, num_shards * b)
         gotx = gotx.at[idx].set(staged_rows.astype(gotx.dtype),

@@ -40,21 +40,27 @@ from .sharding import ShardedFeature, ShardedGraph
 
 
 def _gather_xy_local(node, rows, labels_blk, f, g, axis_name,
-                     dedup_gather, route, fused, fuse_xy):
+                     dedup_gather, route, fused, fuse_xy,
+                     fused_frontier="off"):
     """Per-shard feature+label gather for one sampled node list — the
     shared body of the serial and scanned dist train steps (one routing
-    plan + one payload collective when the id spaces agree)."""
+    plan + one payload collective when the id spaces agree).
+    ``fused_frontier`` selects the serving-side fused dedup+gather kernel
+    on the FEATURE exchange (label columns are 1-wide — nothing to fuse);
+    bit-identical either way."""
     if fuse_xy:
         x, y = exchange_gather_xy(
             node, rows, labels_blk, f.nodes_per_shard, f.num_shards,
-            axis_name, dedup=dedup_gather, route=route, fused=fused)
+            axis_name, dedup=dedup_gather, route=route, fused=fused,
+            fused_frontier=fused_frontier)
     elif dedup_gather:
         # ONE unique pass feeds both exchanges; rows/labels scatter
         # back to every original position (bit-identical batch).
         uniq, inv, _ = unique_first_occurrence(node)
         x = _dedup_scatter_back(
             exchange_gather(uniq, rows, f.nodes_per_shard,
-                            f.num_shards, axis_name, route=route),
+                            f.num_shards, axis_name, route=route,
+                            fused_frontier=fused_frontier),
             inv)
         y = _dedup_scatter_back(
             exchange_gather(uniq, labels_blk[:, None].astype(jnp.int32),
@@ -63,7 +69,8 @@ def _gather_xy_local(node, rows, labels_blk, f, g, axis_name,
             inv)[:, 0]
     else:
         x = exchange_gather(node, rows, f.nodes_per_shard,
-                            f.num_shards, axis_name, route=route)
+                            f.num_shards, axis_name, route=route,
+                            fused_frontier=fused_frontier)
         y = exchange_gather(node,
                             labels_blk[:, None].astype(jnp.int32),
                             g.nodes_per_shard, g.num_shards,
@@ -87,6 +94,7 @@ def make_dist_train_step(
     dedup_gather: bool = False,
     route: str = "auto",
     fused: Optional[bool] = None,
+    fused_frontier: str = "off",
 ):
     """Build ``step(state, seeds [S, B], key) -> (state, loss, acc)``.
 
@@ -106,6 +114,10 @@ def make_dist_train_step(
     collectives (see :mod:`~glt_tpu.parallel.dist_sampler`): features +
     labels ride ONE routing plan and ONE payload collective
     (:func:`~glt_tpu.parallel.dist_feature.exchange_gather_xy`).
+    ``fused_frontier`` != 'off' serves each shard's landed feature
+    requests through the one-dispatch dedup+gather kernel inside
+    shard_map (sampling stays per-shard local; see
+    :func:`~glt_tpu.parallel.dist_feature._request_rows`).
     """
     gspec = P(axis_name)
     # Feature/label fusion needs one id space for both (always true for
@@ -130,7 +142,7 @@ def make_dist_train_step(
         # single unique pass) — see _gather_xy_local.
         x, y = _gather_xy_local(out.node, rows, labels_blk, f, g,
                                 axis_name, dedup_gather, route, fused,
-                                fuse_xy)
+                                fuse_xy, fused_frontier)
         edge_index = jnp.stack([out.row, out.col])
 
         def loss_fn(p):
@@ -187,6 +199,7 @@ def make_scanned_dist_train_step(
     dedup_gather: bool = False,
     route: str = "auto",
     fused: Optional[bool] = None,
+    fused_frontier: str = "off",
 ):
     """ONE jitted program trains ``G`` consecutive distributed batches.
 
@@ -207,6 +220,13 @@ def make_scanned_dist_train_step(
     slot (every shard's seeds all ``-1``) is an exact no-op — params,
     opt state, and the step counter hold, so a padded trailing block
     equals the serial loop over real batches only.
+
+    ``fused_frontier`` != 'off' routes the per-shard feature serving of
+    every scan slot through the fused dedup+gather kernel (sampling
+    stays per-shard local; the kernel runs inside shard_map and compiles
+    under the scanned dist program's compilewatch label); bit-identical
+    batches, VMEM-overflowing request blocks fall back to the unfused
+    serve.
     """
     gspec = P(axis_name)
     blkspec = P(None, axis_name)
@@ -232,7 +252,7 @@ def make_scanned_dist_train_step(
                 route=route, fused=fused)
             x, y = _gather_xy_local(out.node, rows, labels_blk, f, g,
                                     axis_name, dedup_gather, route,
-                                    fused, fuse_xy)
+                                    fused, fuse_xy, fused_frontier)
             edge_index = jnp.stack([out.row, out.col])
 
             def loss_fn(p):

@@ -179,6 +179,7 @@ class NeighborSampler(BaseSampler):
         dedup: str = "auto",
         last_hop_dedup: bool = True,
         node_capacity: Optional[int] = None,
+        sample_force: str = "auto",
     ):
         self.graph = graph
         self.num_neighbors = list(num_neighbors)
@@ -186,6 +187,11 @@ class NeighborSampler(BaseSampler):
         self.frontier_cap = frontier_cap
         self.with_edge = with_edge
         self.last_hop_dedup = bool(last_hop_dedup)
+        # Neighbor-read kernel seam, passed through to every
+        # sample_neighbors call ('auto'|'pallas'|'xla'|'interpret'; see
+        # ops/sample_pallas.py).  'auto' serves whatever autotune_sample
+        # memoized for each hop's exact (width, fanout) shape.
+        self.sample_force = sample_force
         self._base_key = jax.random.PRNGKey(seed)
         self._call_count = 0
 
@@ -238,7 +244,8 @@ class NeighborSampler(BaseSampler):
             self._full_sibling = NeighborSampler(
                 self.graph, self.num_neighbors, self.batch_size,
                 frontier_cap=self.frontier_cap, with_edge=self.with_edge,
-                dedup=self.dedup, last_hop_dedup=self.last_hop_dedup)
+                dedup=self.dedup, last_hop_dedup=self.last_hop_dedup,
+                sample_force=self.sample_force)
         return self._full_sibling
 
     # -- key management ----------------------------------------------------
@@ -297,7 +304,8 @@ class NeighborSampler(BaseSampler):
             last = i + 1 == len(fanouts)
             out = sample_neighbors(indptr, indices, frontier, f, keys[i],
                                    edge_ids=edge_ids,
-                                   with_edge=self.with_edge)
+                                   with_edge=self.with_edge,
+                                   force=self.sample_force)
             # Seed-side local indices (position of frontier nodes in node_buf).
             src_local = frontier_start + jnp.arange(w, dtype=jnp.int32)
             src_local = jnp.where(frontier >= 0, src_local, PADDING_ID)
@@ -499,7 +507,8 @@ class NeighborSampler(BaseSampler):
         g = self.graph
         return sample_neighbors(g.indptr, g.indices, srcs, fanout, key,
                                 edge_ids=g.gather_edge_ids,
-                                with_edge=self.with_edge)
+                                with_edge=self.with_edge,
+                                force=self.sample_force)
 
     # -- link path (cf. neighbor_sampler.py:255 sample_from_edges) ---------
     def sample_from_edges(self, inputs: EdgeSamplerInput,

@@ -116,11 +116,93 @@ def test_fused_epoch_cache_stats_match_serial_stream():
     assert stats_f["lookups"] > 0
 
 
+def test_fused_frontier_scanned_epoch_matches_unfused():
+    """fused_frontier='interpret' compiles the dedup+gather Pallas kernel
+    into the scan body (dim 128 -> the kernel path, not the fallback);
+    the trained values must match the unfused program bit for bit."""
+    from glt_tpu.models import TrainState, make_scanned_node_train_step
+    from glt_tpu.sampler import NeighborSampler
+
+    ds, labels = _cluster_dataset(dim=128)
+    model = GraphSAGE(hidden_features=8, out_features=3, num_layers=2,
+                      dropout_rate=0.0)
+    tx = optax.adam(1e-2)
+    bs, G = 8, 3
+    sampler = NeighborSampler(ds.get_graph(), [3, 3], batch_size=bs,
+                              with_edge=False)
+    feat = ds.get_node_feature()
+    x0 = jnp.zeros((sampler.node_capacity, feat.shape[1]), jnp.float32)
+    ei0 = jnp.full((2, sampler.edge_capacity), -1, jnp.int32)
+    m0 = jnp.zeros((sampler.edge_capacity,), bool)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
+
+    block = np.arange(G * bs).reshape(G, bs).astype(np.int32)
+    base = jax.random.PRNGKey(11)
+
+    def run(ff):
+        step = make_scanned_node_train_step(model, tx, sampler, feat,
+                                            labels, bs, fused_frontier=ff)
+        st = TrainState(params=params, opt_state=tx.init(params),
+                        step=jnp.zeros((), jnp.int32))
+        st, ls, accs, _ = step(st, block, base)
+        return st, [float(x) for x in ls], [float(a) for a in accs]
+
+    st_off, losses_off, accs_off = run("off")
+    st_on, losses_on, accs_on = run("interpret")
+    assert losses_off == losses_on
+    assert accs_off == accs_on
+    assert _params_bits_equal(st_off.params, st_on.params)
+
+
+def test_fused_frontier_yields_to_feature_cache():
+    """When a feature cache is threaded, the cache serves the gather and
+    fused_frontier must stay out of the way: losses, params, AND cache
+    counters identical whether or not the fused path is requested."""
+    from glt_tpu.data.feature_cache import cache_init, publish_cache_stats
+    from glt_tpu.models import TrainState, make_scanned_node_train_step
+    from glt_tpu.sampler import NeighborSampler
+
+    ds, labels = _cluster_dataset()
+    model = GraphSAGE(hidden_features=8, out_features=3, num_layers=2,
+                      dropout_rate=0.0)
+    tx = optax.adam(1e-2)
+    bs, G = 8, 3
+    sampler = NeighborSampler(ds.get_graph(), [3, 3], batch_size=bs,
+                              with_edge=False)
+    feat = ds.get_node_feature()
+    x0 = jnp.zeros((sampler.node_capacity, feat.shape[1]), jnp.float32)
+    ei0 = jnp.full((2, sampler.edge_capacity), -1, jnp.int32)
+    m0 = jnp.zeros((sampler.edge_capacity,), bool)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
+    block = np.arange(G * bs).reshape(G, bs).astype(np.int32)
+    base = jax.random.PRNGKey(23)
+
+    def run(ff):
+        step = make_scanned_node_train_step(
+            model, tx, sampler, feat, labels, bs,
+            feature_cache=cache_init(feat.size, 32, feat.shape[1],
+                                     jnp.float32),
+            fused_frontier=ff)
+        st = TrainState(params=params, opt_state=tx.init(params),
+                        step=jnp.zeros((), jnp.int32))
+        st, ls, _, _ = step(st, block, base)
+        return st, [float(x) for x in ls], \
+            publish_cache_stats(step.feature_cache())
+
+    st_off, losses_off, stats_off = run("off")
+    st_on, losses_on, stats_on = run("interpret")
+    assert losses_off == losses_on
+    assert _params_bits_equal(st_off.params, st_on.params)
+    for k in ("hits", "misses", "lookups", "resident"):
+        assert stats_off[k] == stats_on[k], (k, stats_off, stats_on)
+    assert stats_off["lookups"] > 0
+
+
 # ---------------------------------------------------------------------------
 # dist: scanned fused dist step vs the serial dist step
 # ---------------------------------------------------------------------------
 
-def _dist_setup(bs=4, fanouts=(3, 3)):
+def _dist_setup(bs=4, fanouts=(3, 3), dim=8):
     devs = jax.devices()[:N_DEV]
     mesh = Mesh(np.array(devs), ("shard",))
     n, classes = 64, 4
@@ -136,7 +218,8 @@ def _dist_setup(bs=4, fanouts=(3, 3)):
     topo = CSRTopo(np.stack([np.array(src), np.array(dst)]), num_nodes=n)
     feat = np.eye(classes, dtype=np.float32)[labels]
     feat = np.concatenate(
-        [feat, rng.normal(0, .1, (n, 4)).astype(np.float32)], 1)
+        [feat, rng.normal(0, .1, (n, dim - classes)).astype(np.float32)],
+        1)
 
     from glt_tpu.parallel import shard_feature, shard_graph
 
@@ -289,3 +372,69 @@ def test_run_scanned_dist_epoch_driver():
         m_losses += [float(x) for x in np.asarray(ls)]
     assert [float(x) for x in losses] == m_losses[:2]
     assert _params_bits_equal(st.params, st2.params)
+
+
+def test_dist_step_fused_frontier_matches_bits():
+    """Serving-side fused_frontier threading through the serial dist
+    step: dim 8 takes the documented fallback (width not a lane
+    multiple), which must be bit-identical to the take+where serve."""
+    from glt_tpu.parallel import init_dist_state, make_dist_train_step
+
+    mesh, g, f, lab, model, tx, fanouts, bs = _dist_setup()
+    rng = np.random.default_rng(4)
+    real = np.stack([rng.choice(np.arange(s * 8, (s + 1) * 8), bs,
+                                replace=False)
+                     for s in range(N_DEV)]).astype(np.int32)
+    key = jax.random.PRNGKey(7)
+
+    outs = {}
+    for ff in ("off", "interpret"):
+        step = make_dist_train_step(model, tx, g, f, lab, mesh, fanouts,
+                                    bs, fused_frontier=ff)
+        st, loss, acc = step(
+            init_dist_state(model, tx, g, f, jax.random.PRNGKey(0),
+                            fanouts, bs),
+            jnp.asarray(real), key)
+        outs[ff] = (float(loss), float(acc), st.params)
+
+    assert outs["off"][0] == outs["interpret"][0]
+    assert outs["off"][1] == outs["interpret"][1]
+    assert _params_bits_equal(outs["off"][2], outs["interpret"][2])
+
+
+@pytest.mark.slow
+def test_scanned_dist_fused_frontier_matches_bits():
+    """Dist half of the fused-frontier guarantee: dim 128 drives the
+    REAL kernel (interpret mode) inside shard_map inside the scan body,
+    and every trained value matches the unfused scanned program bit for
+    bit.  Slow: compiles two scanned dist programs — CI runs it in the
+    microbench-smoke job's unfiltered fused-epoch step."""
+    from glt_tpu.parallel import (
+        init_dist_state,
+        make_scanned_dist_train_step,
+    )
+
+    mesh, g, f, lab, model, tx, fanouts, bs = _dist_setup(dim=128)
+    G = 2
+    rng = np.random.default_rng(6)
+    blk = np.stack([
+        np.stack([rng.choice(np.arange(s * 8, (s + 1) * 8), bs,
+                             replace=False)
+                  for s in range(N_DEV)])
+        for _ in range(G)]).astype(np.int32)
+    base = jax.random.PRNGKey(13)
+
+    outs = {}
+    for ff in ("off", "interpret"):
+        sstep = make_scanned_dist_train_step(model, tx, g, f, lab, mesh,
+                                             fanouts, bs,
+                                             fused_frontier=ff)
+        st = init_dist_state(model, tx, g, f, jax.random.PRNGKey(0),
+                             fanouts, bs)
+        st, losses, accs = sstep(st, blk, base)
+        outs[ff] = ([float(x) for x in losses],
+                    [float(a) for a in accs], st.params)
+
+    assert outs["off"][0] == outs["interpret"][0]
+    assert outs["off"][1] == outs["interpret"][1]
+    assert _params_bits_equal(outs["off"][2], outs["interpret"][2])

@@ -1,0 +1,116 @@
+"""fused_frontier bit-identity vs the unfused dedup+gather sandwich.
+
+The fused kernel runs in interpret mode on CPU (hardware-free tier-1);
+the contract under test is exact: ``features`` must match
+``dedup_gather_rows`` bit for bit, ``unique_ids``/``inverse`` must match
+``unique_first_occurrence``, and VMEM-overflow / odd-width frontiers
+must fall back to the unfused path without changing a single bit.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from glt_tpu.ops.dedup_gather import dedup_gather_rows
+from glt_tpu.ops.fused_frontier import (
+    DEFAULT_VMEM_BUDGET,
+    fused_frontier,
+    fused_frontier_supported,
+)
+from glt_tpu.ops.unique import unique_first_occurrence
+
+
+def _table_ids(n=64, d=128, b=96, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    table = jnp.asarray(rng.standard_normal((n, d)).astype(dtype))
+    # Heavy duplication + padding — the frontier shape dedup exists for.
+    ids = jnp.asarray(rng.integers(-1, n, b), jnp.int32)
+    return table, ids
+
+
+@pytest.mark.parametrize("force", ["interpret", "xla"])
+def test_bits_match_dedup_gather(force):
+    table, ids = _table_ids()
+    ref = dedup_gather_rows(table, ids)
+    out = fused_frontier(table, ids, force=force)
+    assert jnp.array_equal(ref, out.features)
+    uniq, inv, _ = unique_first_occurrence(ids)
+    assert jnp.array_equal(out.unique_ids, uniq)
+    assert jnp.array_equal(out.inverse, inv)
+
+
+def test_id2index_indirection():
+    table, ids = _table_ids(seed=3)
+    perm = jnp.asarray(np.random.default_rng(4).permutation(64), jnp.int32)
+    ref = dedup_gather_rows(table, ids, id2index=perm)
+    out = fused_frontier(table, ids, id2index=perm, force="interpret")
+    assert jnp.array_equal(ref, out.features)
+
+
+def test_vmem_overflow_falls_back_bit_identically():
+    table, ids = _table_ids(seed=5)
+    assert fused_frontier_supported(table, ids)
+    assert not fused_frontier_supported(table, ids, vmem_budget=64)
+    ref = dedup_gather_rows(table, ids)
+    out = fused_frontier(table, ids, force="interpret", vmem_budget=64)
+    assert jnp.array_equal(ref, out.features)
+
+
+def test_odd_width_falls_back():
+    # d % 128 != 0: whole-row kernel copies don't tile the lane register
+    # — must silently take the unfused path, same bits.
+    table, ids = _table_ids(d=100, seed=6)
+    assert not fused_frontier_supported(table, ids)
+    ref = dedup_gather_rows(table, ids)
+    out = fused_frontier(table, ids, force="interpret")
+    assert jnp.array_equal(ref, out.features)
+
+
+def test_all_padding_ids():
+    table, _ = _table_ids(seed=7)
+    ids = jnp.full((40,), -1, jnp.int32)
+    out = fused_frontier(table, ids, force="interpret")
+    assert bool((out.features == 0).all())
+    assert bool((out.inverse == -1).all())
+
+
+def test_every_id_unique_and_duplicate_heavy():
+    table, _ = _table_ids(seed=8)
+    # All-unique frontier (dedup a no-op) and a single hot row repeated.
+    for ids in (jnp.arange(48, dtype=jnp.int32),
+                jnp.full((48,), 3, jnp.int32)):
+        ref = dedup_gather_rows(table, ids)
+        out = fused_frontier(table, ids, force="interpret")
+        assert jnp.array_equal(ref, out.features)
+
+
+def test_env_override(monkeypatch):
+    table, ids = _table_ids(seed=9)
+    ref = dedup_gather_rows(table, ids)
+    monkeypatch.setenv("GLT_FUSED_FORCE", "interpret")
+    out = fused_frontier(table, ids)     # auto, overridden by env
+    assert jnp.array_equal(ref, out.features)
+    monkeypatch.setenv("GLT_FUSED_FORCE", "xla")
+    out = fused_frontier(table, ids, force="interpret")
+    assert jnp.array_equal(ref, out.features)
+
+
+def test_inside_jit_and_scan():
+    table, _ = _table_ids(seed=10)
+    ids_blk = jnp.asarray(
+        np.random.default_rng(11).integers(-1, 64, (3, 32)), jnp.int32)
+
+    def epoch(force):
+        def body(c, ids):
+            return c, fused_frontier(table, ids, force=force).features
+        return jax.lax.scan(body, 0, ids_blk)[1]
+
+    a = jax.jit(lambda: epoch("xla"))()
+    b = jax.jit(lambda: epoch("interpret"))()
+    assert jnp.array_equal(a, b)
+
+
+def test_budget_constant_sane():
+    # The default unique-block budget must leave VMEM headroom (~16 MB
+    # per core) for the output chunk and surrounding program.
+    assert 0 < DEFAULT_VMEM_BUDGET <= 12 * 2**20
