@@ -95,17 +95,30 @@ def build_project(paths: Iterable[str]
 
 def analyze_project(project: Project,
                     rules: Optional[Sequence[Rule]] = None,
-                    suppress: bool = True) -> List[Finding]:
-    """Run the given rules (default: all) over every project module."""
+                    suppress: bool = True,
+                    rule_timings: Optional[Dict[str, float]] = None
+                    ) -> List[Finding]:
+    """Run the given rules (default: all) over every project module.
+
+    When ``rule_timings`` is given, each rule's cumulative wall time
+    across all modules is accumulated into it (keyed by rule name) —
+    the ``--profile`` per-pass table and the CI perf guard read this.
+    """
     rules = list(rules) if rules is not None else all_rules()
     findings: List[Finding] = []
     for path in sorted(project.by_path):
         module = project.by_path[path]
         module_findings: List[Finding] = []
         for rule in rules:
+            if rule_timings is None:
+                module_findings.extend(rule.check(module, project))
+                continue
+            t0 = time.perf_counter()
             module_findings.extend(rule.check(module, project))
-        if suppress:
-            module_findings = apply_suppressions(
+            rule_timings[rule.name] = (rule_timings.get(rule.name, 0.0)
+                                       + time.perf_counter() - t0)
+        if suppress and module_findings:   # tokenizing clean files is
+            module_findings = apply_suppressions(   # pure overhead
                 module_findings, Suppressions.from_source(module.source))
         findings.extend(module_findings)
     return findings
@@ -178,6 +191,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "(default: glt_tpu)")
     parser.add_argument("--select", metavar="RULES",
                         help="comma-separated rule names/codes to run")
+    parser.add_argument("--rule", metavar="RULE",
+                        help="run exactly one rule (name or code) and "
+                             "skip the call-graph/effect build — the "
+                             "fast inner loop while fixing one finding "
+                             "class")
     parser.add_argument("--ignore", metavar="RULES",
                         help="comma-separated rule names/codes to skip")
     parser.add_argument("--strict", action="store_true",
@@ -204,16 +222,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   f"{rule.description}")
         return 0
 
-    rules = _select_rules(args.select, args.ignore)
+    if args.rule and args.select:
+        parser.error("--rule and --select are mutually exclusive")
+    if args.rule:
+        if "," in args.rule:
+            parser.error("--rule takes exactly one rule "
+                         "(use --select for a list)")
+        rules = _select_rules(args.rule, args.ignore)
+    else:
+        rules = _select_rules(args.select, args.ignore)
     timings: List[Tuple[str, float]] = []
     t0 = time.perf_counter()
     project, findings = build_project(args.paths)
     timings.append(("parse+symbols", time.perf_counter() - t0))
+    if not args.rule:
+        # Single-rule mode skips the forced build: a rule that needs
+        # effects still triggers it lazily, but GLT017-021 style passes
+        # stay under a second for the fix-one-finding inner loop.
+        t0 = time.perf_counter()
+        project.effects        # force callgraph + effect summaries
+        timings.append(("callgraph+effects", time.perf_counter() - t0))
     t0 = time.perf_counter()
-    project.effects            # force callgraph + effect summaries
-    timings.append(("callgraph+effects", time.perf_counter() - t0))
-    t0 = time.perf_counter()
-    findings = findings + analyze_project(project, rules)
+    rule_timings: Dict[str, float] = {}
+    findings = findings + analyze_project(
+        project, rules, rule_timings=rule_timings if args.profile else None)
     timings.append(("rules", time.perf_counter() - t0))
 
     if args.write_baseline:
@@ -241,6 +273,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for name, dt in timings:
             print(f"gltlint --profile: {name:18s} {dt * 1e3:8.1f} ms",
                   file=sys.stderr)
+        for name, dt in sorted(rule_timings.items(),
+                               key=lambda kv: -kv[1]):
+            print(f"gltlint --profile:   pass {name:26s} "
+                  f"{dt * 1e3:8.1f} ms", file=sys.stderr)
         print(f"gltlint --profile: {'total':18s} {total * 1e3:8.1f} ms",
               file=sys.stderr)
     gate = (findings if args.strict else

@@ -1,0 +1,1134 @@
+"""Static device-program model of Pallas kernels: GLT017/018/019.
+
+ROADMAP item 1's blunt truth is that every Pallas kernel in ``ops/``
+has only ever run in interpret mode; the first hardware run pays for a
+VMEM overflow, an unbalanced DMA ring, or a misaligned tile with an
+opaque Mosaic crash.  This pass reconstructs what the chip will see —
+without importing JAX — by modeling every ``pl.pallas_call`` site from
+the AST:
+
+* **GLT017 vmem-budget-exceeded** — closed-form VMEM byte accounting.
+  The model extracts BlockSpec block shapes, ``out_shape`` structs and
+  ``pltpu.VMEM`` scratch shapes, resolves each dimension through the
+  symbol table (module constants, cross-module constants such as
+  ``ops/tpu_limits.py``, local assignments, loop targets, pure int
+  helpers like ``_bin_width``, function defaults), and sweeps every
+  unresolved symbol over the module's declared ``VMEM_MODEL_DOMAIN`` —
+  which the kernel modules build from the same ``CANDIDATE_*`` tuples
+  their autotuner sweeps, so every ``candidate_{gather,sample}_params``
+  point is checked statically.  Pipelined (gridded) in/out blocks are
+  double-buffered by Mosaic and count twice; a dimension the model
+  cannot bound is itself an ERROR (the domain declaration is the fix),
+  so the accounting stays total rather than silently partial.
+
+* **GLT018 unbalanced-dma-ring** — ``make_async_copy(...).start()`` /
+  ``.wait()`` symmetry per ring.  Ring-control guards (``j + nbuf <
+  nd``) differ between the fill prologue and the steady state by
+  construction; what must match exactly are the *data-dependent*
+  predicates (those reading a kernel ref, e.g. ``binid_ref[...] ==
+  bin_id``): a row-skip predicate on ``start`` that no ``wait`` shares
+  leaves the unguarded wait blocking on a never-signaled semaphore,
+  and the converse leaves a dangling DMA to corrupt its slot on reuse
+  — the exact bug class ``sample_pallas.py`` hand-comments against.
+  Guards are canonicalized by collapsing loop-index arithmetic, so the
+  prologue's ``binid_ref[base + k]`` and the steady state's
+  ``binid_ref[base + j + nbuf]`` compare equal.
+
+* **GLT019 unaligned-tile-shape** — per resolved buffer point: the
+  last dim must tile the 128-lane register and the sublane dim must
+  honor the dtype's floor (f32 8, bf16 16, int8/fp8 32 — the rule
+  ``gather_pallas`` previously encoded by convention only).  Buffers
+  with unresolvable dtypes are checked at the f32 floor.
+
+Limits come from ``ops/tpu_limits.py`` resolved through the project
+symbol table (falling back to the same values when linting a lone
+fixture), so the kernels and this analyzer can never disagree.
+"""
+from __future__ import annotations
+
+import ast
+import itertools
+from typing import Dict, List, Optional, Set, Tuple
+
+from .report import Finding, Severity
+from .rules import Rule, register
+from .visitor import ModuleInfo, FunctionScope, walk_own
+
+# Canonical (post alias-resolution) dotted names.
+_PALLAS_CALL = "jax.experimental.pallas.pallas_call"
+_BLOCK_SPEC = ("jax.experimental.pallas.BlockSpec",)
+_GRID_SPECS = ("jax.experimental.pallas.tpu.PrefetchScalarGridSpec",
+               "jax.experimental.pallas.GridSpec")
+_VMEM_SCRATCH = "jax.experimental.pallas.tpu.VMEM"
+_DMA_SEM = "jax.experimental.pallas.tpu.SemaphoreType.DMA"
+_SHAPE_STRUCT = ("jax.ShapeDtypeStruct",)
+_WHEN = "jax.experimental.pallas.when"
+_ASYNC_COPY = "jax.experimental.pallas.tpu.make_async_copy"
+_LOOPS = ("jax.lax.fori_loop", "jax.lax.while_loop")
+
+_DOMAIN_NAME = "VMEM_MODEL_DOMAIN"
+_LIMITS_MODULE_SUFFIX = ".ops.tpu_limits"
+
+# Fallbacks when ops/tpu_limits.py is not part of the analyzed file set
+# (single-fixture runs).  Values mirror that module exactly.
+_FALLBACK_LIMITS = {
+    "VMEM_BYTES": 16 * 2**20,
+    "LANE": 128,
+    "SUBLANE_F32": 8,
+}
+
+_ITEMSIZE = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool_": 1, "bool": 1,
+    "float8_e4m3fn": 1, "float8_e5m2": 1,
+}
+
+_MAX_CANDIDATES = 64        # cap per-expression candidate sets
+_MAX_POINTS = 512           # cap cross products
+
+_NUM_BINOPS = {
+    ast.Add: lambda a, b: a + b,
+    ast.Sub: lambda a, b: a - b,
+    ast.Mult: lambda a, b: a * b,
+    ast.FloorDiv: lambda a, b: a // b if b else None,
+    ast.Mod: lambda a, b: a % b if b else None,
+    ast.Pow: lambda a, b: a ** b if abs(b) < 64 else None,
+    ast.LShift: lambda a, b: a << b if 0 <= b < 64 else None,
+    ast.RShift: lambda a, b: a >> b if 0 <= b < 64 else None,
+}
+
+
+def _dtype_name(module: ModuleInfo, expr: Optional[ast.expr]
+                ) -> Optional[str]:
+    """'float32' for ``jnp.float32`` / ``np.int32`` style exprs."""
+    if expr is None:
+        return None
+    dotted = module.imports.resolve(expr)
+    if dotted is None:
+        return None
+    leaf = dotted.rsplit(".", 1)[-1]
+    return leaf if leaf in _ITEMSIZE else None
+
+
+def _module_consts(module: ModuleInfo) -> Dict[str, ast.expr]:
+    """Module-level ``NAME = <expr>`` assignments (last one wins)."""
+    cached = getattr(module, "_km_consts", None)
+    if cached is not None:
+        return cached
+    out: Dict[str, ast.expr] = {}
+    for stmt in module.tree.body:
+        if isinstance(stmt, ast.Assign) and stmt.value is not None:
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = stmt.value
+        elif (isinstance(stmt, ast.AnnAssign) and stmt.value is not None
+              and isinstance(stmt.target, ast.Name)):
+            out[stmt.target.id] = stmt.value
+    module._km_consts = out
+    return out
+
+
+def _module_functions(module: ModuleInfo) -> Dict[str, ast.FunctionDef]:
+    cached = getattr(module, "_km_funcs", None)
+    if cached is not None:
+        return cached
+    out = {stmt.name: stmt for stmt in module.tree.body
+           if isinstance(stmt, ast.FunctionDef)}
+    module._km_funcs = out
+    return out
+
+
+def _project_module(project, dotted: str
+                    ) -> Tuple[Optional[ModuleInfo], Optional[str]]:
+    """Split a canonical dotted path into (defining module, attr)."""
+    if project is None or "." not in dotted:
+        return None, None
+    mod_name, attr = dotted.rsplit(".", 1)
+    m = project.modules.get(mod_name)
+    if m is not None:
+        return m, attr
+    return None, None
+
+
+def const_value(module: ModuleInfo, expr: ast.expr, project=None,
+                _depth: int = 0):
+    """Resolve ``expr`` to a Python constant (int/str/bool/tuple) through
+    literals, module constants, and cross-module constants.  Returns the
+    value or None (None is never a legal constant here)."""
+    if _depth > 12 or expr is None:
+        return None
+    if isinstance(expr, ast.Constant):
+        v = expr.value
+        return v if isinstance(v, (int, str, bool)) else None
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        vals = tuple(const_value(module, el, project, _depth + 1)
+                     for el in expr.elts)
+        return None if any(v is None for v in vals) else vals
+    if isinstance(expr, ast.UnaryOp) and isinstance(
+            expr.op, (ast.USub, ast.UAdd)):
+        v = const_value(module, expr.operand, project, _depth + 1)
+        if isinstance(v, int):
+            return -v if isinstance(expr.op, ast.USub) else v
+        return None
+    if isinstance(expr, ast.BinOp):
+        fn = _NUM_BINOPS.get(type(expr.op))
+        a = const_value(module, expr.left, project, _depth + 1)
+        b = const_value(module, expr.right, project, _depth + 1)
+        if fn and isinstance(a, int) and isinstance(b, int):
+            try:
+                return fn(a, b)
+            except Exception:
+                return None
+        return None
+    if isinstance(expr, ast.Subscript):
+        base = const_value(module, expr.value, project, _depth + 1)
+        idx = const_value(module, expr.slice, project, _depth + 1)
+        if isinstance(base, tuple) and isinstance(idx, int) \
+                and -len(base) <= idx < len(base):
+            return base[idx]
+        return None
+    if isinstance(expr, ast.Name):
+        own = _module_consts(module).get(expr.id)
+        if own is not None:
+            return const_value(module, own, project, _depth + 1)
+        dotted = module.imports.alias_of(expr.id)
+        if dotted:
+            m, attr = _project_module(project, dotted)
+            if m is not None and attr:
+                node = _module_consts(m).get(attr)
+                if node is not None:
+                    return const_value(m, node, project, _depth + 1)
+        return None
+    if isinstance(expr, ast.Attribute):
+        dotted = module.imports.resolve(expr)
+        if dotted:
+            m, attr = _project_module(project, dotted)
+            if m is not None and attr:
+                node = _module_consts(m).get(attr)
+                if node is not None:
+                    return const_value(m, node, project, _depth + 1)
+        return None
+    return None
+
+
+def _limits(module: ModuleInfo, project) -> Dict[str, int]:
+    """Device limits from ops/tpu_limits.py through the symbol table,
+    falling back to mirrored values for lone-fixture analysis."""
+    out = dict(_FALLBACK_LIMITS)
+    lim_mod = None
+    if project is not None:
+        for name, m in project.modules.items():
+            if name.endswith(_LIMITS_MODULE_SUFFIX) or name == "tpu_limits":
+                lim_mod = m
+                break
+    if lim_mod is None and (module.name.endswith(_LIMITS_MODULE_SUFFIX)
+                            or module.name == "tpu_limits"):
+        lim_mod = module
+    if lim_mod is not None:
+        for key in out:
+            node = _module_consts(lim_mod).get(key)
+            val = (const_value(lim_mod, node, project)
+                   if node is not None else None)
+            if isinstance(val, int):
+                out[key] = val
+    return out
+
+
+def _sublane_floor(dtype: Optional[str], f32_floor: int) -> int:
+    size = _ITEMSIZE.get(dtype or "float32", 4)
+    return max(f32_floor, 32 // max(size, 1))
+
+
+# ---------------------------------------------------------------------------
+# candidate resolution
+# ---------------------------------------------------------------------------
+
+class _SiteResolver:
+    """Resolves dimension expressions at one pallas_call site to the set
+    of statically-possible values, sweeping unresolved symbols over the
+    module's VMEM_MODEL_DOMAIN declaration."""
+
+    def __init__(self, module: ModuleInfo, scope: Optional[FunctionScope],
+                 project):
+        self.module = module
+        self.scope = scope
+        self.project = project
+        self.simple: Dict[str, List[object]] = {}
+        self.joint: List[Tuple[Tuple[str, ...], List[Tuple]]] = []
+        self._cache: Dict[str, Optional[List[object]]] = {}
+        self._stack: Set[str] = set()
+        self._load_domain()
+
+    # -- domain ------------------------------------------------------------
+    def _load_domain(self) -> None:
+        node = _module_consts(self.module).get(_DOMAIN_NAME)
+        if not isinstance(node, ast.Dict):
+            return
+        for key, value in zip(node.keys, node.values):
+            kval = const_value(self.module, key, self.project)
+            vval = const_value(self.module, value, self.project)
+            if vval is None:
+                continue
+            if isinstance(kval, str):
+                self.simple[kval] = (list(vval) if isinstance(vval, tuple)
+                                     else [vval])
+            elif (isinstance(kval, tuple)
+                  and all(isinstance(s, str) for s in kval)
+                  and isinstance(vval, tuple)):
+                points = [p for p in vval
+                          if isinstance(p, tuple) and len(p) == len(kval)]
+                if points:
+                    self.joint.append((kval, points))
+
+    def joint_group_of(self, name: str) -> Optional[int]:
+        for i, (syms, _) in enumerate(self.joint):
+            if name in syms:
+                return i
+        return None
+
+    # -- candidates --------------------------------------------------------
+    def candidates(self, expr: ast.expr, _depth: int = 0
+                   ) -> Optional[List[object]]:
+        """All statically-possible values of ``expr`` at this site, or
+        None when the model cannot bound it."""
+        if _depth > 12 or expr is None:
+            return None
+        v = const_value(self.module, expr, self.project)
+        if v is not None:
+            return [v]
+        if isinstance(expr, ast.Name):
+            return self._name_candidates(expr.id, _depth)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            per = [self.candidates(el, _depth + 1) for el in expr.elts]
+            if any(p is None for p in per):
+                return None
+            out = [tuple(pt) for pt in itertools.product(*per)]
+            return out[:_MAX_CANDIDATES]
+        if isinstance(expr, ast.UnaryOp) and isinstance(
+                expr.op, (ast.USub, ast.UAdd)):
+            vals = self.candidates(expr.operand, _depth + 1)
+            if vals is None:
+                return None
+            sign = -1 if isinstance(expr.op, ast.USub) else 1
+            return [sign * x for x in vals if isinstance(x, int)] or None
+        if isinstance(expr, ast.BinOp):
+            fn = _NUM_BINOPS.get(type(expr.op))
+            if fn is None:
+                return None
+            lv = self.candidates(expr.left, _depth + 1)
+            rv = self.candidates(expr.right, _depth + 1)
+            if lv is None or rv is None:
+                return None
+            out: List[object] = []
+            for a, b in itertools.islice(
+                    itertools.product(lv, rv), _MAX_POINTS):
+                if isinstance(a, int) and isinstance(b, int):
+                    try:
+                        r = fn(a, b)
+                    except Exception:
+                        r = None
+                    if r is not None:
+                        out.append(r)
+            return sorted(set(out))[:_MAX_CANDIDATES] or None
+        if isinstance(expr, ast.Subscript):
+            base = self.candidates(expr.value, _depth + 1)
+            idx = self.candidates(expr.slice, _depth + 1)
+            if base is None or idx is None:
+                return None
+            out = []
+            for b, i in itertools.product(base, idx):
+                if isinstance(b, tuple) and isinstance(i, int) \
+                        and -len(b) <= i < len(b):
+                    out.append(b[i])
+            return sorted(set(out))[:_MAX_CANDIDATES] or None
+        if isinstance(expr, ast.Call):
+            return self._call_candidates(expr, _depth)
+        return None
+
+    def _call_candidates(self, call: ast.Call, _depth: int
+                         ) -> Optional[List[object]]:
+        if not isinstance(call.func, ast.Name) or call.keywords:
+            return None
+        args = [self.candidates(a, _depth + 1) for a in call.args]
+        if any(a is None for a in args):
+            return None
+        fname = call.func.id
+        if fname in ("max", "min", "len", "sum") and args:
+            out = []
+            fn = {"max": max, "min": min, "len": len, "sum": sum}[fname]
+            for pt in itertools.islice(itertools.product(*args),
+                                       _MAX_POINTS):
+                try:
+                    vals = (pt[0] if len(pt) == 1
+                            and isinstance(pt[0], tuple) else pt)
+                    out.append(fn(vals))
+                except Exception:
+                    pass
+            return sorted(set(out))[:_MAX_CANDIDATES] or None
+        # Pure int helper: a module-level def whose body is one Return
+        # of an arithmetic expression over its params and constants
+        # (the `_bin_width` shape).
+        fdef = _module_functions(self.module).get(fname)
+        if fdef is None:
+            return None
+        body = [s for s in fdef.body
+                if not (isinstance(s, ast.Expr)
+                        and isinstance(s.value, ast.Constant))]
+        if len(body) != 1 or not isinstance(body[0], ast.Return) \
+                or body[0].value is None:
+            return None
+        params = [a.arg for a in fdef.args.args]
+        if len(call.args) > len(params):
+            return None
+        env: Dict[str, List[object]] = dict(zip(params, args))
+        # defaults for unbound params
+        defaults = fdef.args.defaults
+        for p, d in zip(params[len(params) - len(defaults):], defaults):
+            if p not in env:
+                dv = self.candidates(d, _depth + 1)
+                if dv is None:
+                    return None
+                env[p] = dv
+        if set(params) - set(env):
+            return None
+        return self._eval_env(body[0].value, env, _depth + 1)
+
+    def _eval_env(self, expr: ast.expr, env: Dict[str, List[object]],
+                  _depth: int) -> Optional[List[object]]:
+        """Evaluate a helper's return expression under candidate bindings
+        for its parameters (module constants still resolve normally)."""
+        free = sorted({n.id for n in ast.walk(expr)
+                       if isinstance(n, ast.Name) and n.id in env})
+        per = [env[n] for n in free]
+        out: List[object] = []
+        saved = {}
+        for pt in itertools.islice(itertools.product(*per), _MAX_POINTS):
+            # temporarily pin the bindings in the candidate cache
+            for n, v in zip(free, pt):
+                saved[n] = self._cache.get(n, "__miss__")
+                self._cache[n] = [v]
+            vals = self.candidates(expr, _depth + 1)
+            for n in free:
+                if saved[n] == "__miss__":
+                    self._cache.pop(n, None)
+                else:
+                    self._cache[n] = saved[n]
+            if vals is None:
+                return None
+            out.extend(vals)
+        uniq = []
+        for v in out:
+            if v not in uniq:
+                uniq.append(v)
+        return uniq[:_MAX_CANDIDATES] or None
+
+    def _name_candidates(self, name: str, _depth: int
+                         ) -> Optional[List[object]]:
+        if name in self._cache:
+            return self._cache[name]
+        if name in self._stack:
+            return None
+        self._stack.add(name)
+        try:
+            out = self._resolve_name(name, _depth)
+        finally:
+            self._stack.discard(name)
+        self._cache[name] = out
+        return out
+
+    def _resolve_name(self, name: str, _depth: int
+                      ) -> Optional[List[object]]:
+        # 1. local bindings in the enclosing scope chain (closures).
+        scope = self.scope
+        while scope is not None:
+            bound = False
+            vals: List[object] = []
+            for node in walk_own(scope.node):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == name
+                        for t in node.targets):
+                    bound = True
+                    got = self.candidates(node.value, _depth + 1)
+                    if got:
+                        vals.extend(got)
+                elif isinstance(node, ast.For):
+                    got = self._loop_candidates(node, name, _depth)
+                    if got is not None:
+                        bound = True
+                        vals.extend(got)
+            if bound and vals:
+                uniq = []
+                for v in vals:
+                    if v not in uniq:
+                        uniq.append(v)
+                return uniq[:_MAX_CANDIDATES]
+            if name in scope.params:
+                got = self._param_candidates(scope, name, _depth)
+                if got is not None:
+                    return got
+                break           # a parameter shadows outer bindings
+            if bound:
+                break           # locally assigned but unresolvable
+            scope = scope.parent
+        # 2. declared model domain.
+        if name in self.simple:
+            return list(self.simple[name])
+        g = self.joint_group_of(name)
+        if g is not None:
+            syms, points = self.joint[g]
+            i = syms.index(name)
+            return sorted({p[i] for p in points})
+        return None
+
+    def _param_candidates(self, scope: FunctionScope, name: str,
+                          _depth: int) -> Optional[List[object]]:
+        if name in self.simple:
+            return list(self.simple[name])
+        if self.joint_group_of(name) is not None:
+            syms, points = self.joint[self.joint_group_of(name)]
+            i = syms.index(name)
+            return sorted({p[i] for p in points})
+        # fall back to the declared default value.
+        args = scope.node.args
+        pos = args.posonlyargs + args.args
+        defaults = args.defaults
+        for p, d in zip(pos[len(pos) - len(defaults):], defaults):
+            if p.arg == name:
+                return self.candidates(d, _depth + 1)
+        for p, d in zip(args.kwonlyargs, args.kw_defaults):
+            if p.arg == name and d is not None:
+                return self.candidates(d, _depth + 1)
+        return None
+
+    def _loop_candidates(self, node: ast.For, name: str, _depth: int
+                         ) -> Optional[List[object]]:
+        """Values a for-target takes over a resolvable iterable
+        (including the second slot of ``enumerate(...)``)."""
+        target, it = node.target, node.iter
+        pick_second = False
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id == "enumerate" and it.args:
+            if isinstance(target, ast.Tuple) and len(target.elts) == 2 \
+                    and isinstance(target.elts[1], ast.Name) \
+                    and target.elts[1].id == name:
+                it = it.args[0]
+                pick_second = True
+            else:
+                return None
+        elif not (isinstance(target, ast.Name) and target.id == name):
+            return None
+        if not pick_second and not (isinstance(target, ast.Name)
+                                    and target.id == name):
+            return None
+        seqs = self.candidates(it, _depth + 1)
+        if seqs is None:
+            return None
+        out: List[object] = []
+        for s in seqs:
+            if isinstance(s, tuple):
+                out.extend(s)
+            else:
+                out.append(s)
+        uniq = []
+        for v in out:
+            if v not in uniq:
+                uniq.append(v)
+        return uniq[:_MAX_CANDIDATES] or None
+
+
+# ---------------------------------------------------------------------------
+# pallas_call site extraction
+# ---------------------------------------------------------------------------
+
+class _Buffer:
+    __slots__ = ("kind", "node", "dims", "dtype", "pipelined")
+
+    def __init__(self, kind, node, dims, dtype, pipelined):
+        self.kind = kind            # 'in block' | 'out block' | 'scratch'
+        self.node = node            # anchor for findings
+        self.dims = dims            # list of ast exprs
+        self.dtype = dtype          # 'float32' | ... | None (assume 4B)
+        self.pipelined = pipelined  # double-buffered across grid steps
+
+
+class _Site:
+    __slots__ = ("call", "scope", "buffers", "ring_slots")
+
+    def __init__(self, call, scope):
+        self.call = call
+        self.scope = scope
+        self.buffers: List[_Buffer] = []
+        self.ring_slots: Optional[ast.expr] = None
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+def _local_assign_value(module: ModuleInfo, scope: Optional[FunctionScope],
+                        name: str) -> Optional[ast.expr]:
+    s = scope
+    while s is not None:
+        for node in walk_own(s.node):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == name
+                    for t in node.targets):
+                return node.value
+        s = s.parent
+    return None
+
+
+def _as_seq(node: Optional[ast.expr]) -> List[ast.expr]:
+    if node is None:
+        return []
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return list(node.elts)
+    return [node]
+
+
+def _extract_sites(module: ModuleInfo) -> List[_Site]:
+    sites: List[_Site] = []
+    covered: Set[int] = set()
+    for scope in module.scopes:
+        for node in walk_own(scope.node):
+            if isinstance(node, ast.Call) \
+                    and module.call_name(node) == _PALLAS_CALL:
+                covered.add(id(node))
+                sites.append(_build_site(module, scope, node))
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and id(node) not in covered \
+                and module.call_name(node) == _PALLAS_CALL:
+            sites.append(_build_site(module, None, node))
+    return sites
+
+
+def _build_site(module: ModuleInfo, scope: Optional[FunctionScope],
+                call: ast.Call) -> _Site:
+    site = _Site(call, scope)
+    spec_call: Optional[ast.Call] = None
+    gs = _kw(call, "grid_spec")
+    if isinstance(gs, ast.Name):
+        val = _local_assign_value(module, scope, gs.id)
+        if isinstance(val, ast.Call):
+            gs = val
+    if isinstance(gs, ast.Call) and module.call_name(gs) in _GRID_SPECS:
+        spec_call = gs
+
+    def spec_kw(name):
+        v = _kw(call, name)
+        if v is None and spec_call is not None:
+            v = _kw(spec_call, name)
+        return v
+
+    has_grid = spec_kw("grid") is not None
+
+    def block_buffer(spec, kind, dtype=None):
+        if not (isinstance(spec, ast.Call)
+                and module.call_name(spec) in _BLOCK_SPEC):
+            return
+        ms = _kw(spec, "memory_space")
+        ms_name = module.imports.resolve(ms) if ms is not None else None
+        if ms_name is not None and (ms_name.endswith(".ANY")
+                                    or ms_name.endswith(".SMEM")):
+            return
+        shape = spec.args[0] if spec.args else None
+        if isinstance(shape, (ast.Tuple, ast.List)) and shape.elts:
+            site.buffers.append(_Buffer(kind, spec, list(shape.elts),
+                                        dtype, has_grid))
+
+    structs = []
+    for st in _as_seq(spec_kw("out_shape") or _kw(call, "out_shape")):
+        if isinstance(st, ast.Call) \
+                and module.call_name(st) in _SHAPE_STRUCT:
+            structs.append(st)
+    out_dtype = None
+    if len(structs) == 1:
+        dt = (structs[0].args[1] if len(structs[0].args) > 1
+              else _kw(structs[0], "dtype"))
+        out_dtype = _dtype_name(module, dt)
+
+    out_specs = _as_seq(spec_kw("out_specs"))
+    for spec in out_specs:
+        block_buffer(spec, "out block", out_dtype)
+    if not any(b.kind == "out block" for b in site.buffers):
+        # No blocked out_specs: the whole output lives in VMEM.
+        for st in structs:
+            shape = st.args[0] if st.args else _kw(st, "shape")
+            dt = st.args[1] if len(st.args) > 1 else _kw(st, "dtype")
+            if isinstance(shape, (ast.Tuple, ast.List)) and shape.elts:
+                site.buffers.append(_Buffer(
+                    "out block", st, list(shape.elts),
+                    _dtype_name(module, dt), False))
+
+    for spec in _as_seq(spec_kw("in_specs")):
+        block_buffer(spec, "in block")
+
+    for sc in _as_seq(spec_kw("scratch_shapes")):
+        if not isinstance(sc, ast.Call):
+            continue
+        name = module.call_name(sc)
+        if name == _VMEM_SCRATCH:
+            shape = sc.args[0] if sc.args else None
+            dt = sc.args[1] if len(sc.args) > 1 else None
+            if isinstance(shape, (ast.Tuple, ast.List)) and shape.elts:
+                site.buffers.append(_Buffer(
+                    "scratch", sc, list(shape.elts),
+                    _dtype_name(module, dt), False))
+        elif name == _DMA_SEM:
+            shape = sc.args[0] if sc.args else None
+            if isinstance(shape, (ast.Tuple, ast.List)) and shape.elts:
+                site.ring_slots = shape.elts[0]
+    return site
+
+
+# ---------------------------------------------------------------------------
+# buffer evaluation
+# ---------------------------------------------------------------------------
+
+def _fmt_bytes(n: int) -> str:
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.1f}MB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f}KB"
+    return f"{n}B"
+
+
+class _EvalError(Exception):
+    def __init__(self, dim_expr):
+        self.dim_expr = dim_expr
+
+
+def _buffer_points(buf: _Buffer, rs: _SiteResolver
+                   ) -> List[Tuple[int, ...]]:
+    """All candidate dim tuples for a buffer (joint-group aware).
+    Raises _EvalError on an unmodelable dimension."""
+    joint_syms: Dict[int, int] = {}   # dim index -> joint group
+    per_dim: List[Optional[List[int]]] = []
+    for i, de in enumerate(buf.dims):
+        if isinstance(de, ast.Name):
+            g = rs.joint_group_of(de.id)
+            if g is not None and not _is_pure_const(rs, de):
+                joint_syms[i] = g
+                per_dim.append(None)
+                continue
+        vals = rs.candidates(de)
+        ints = ([v for v in vals if isinstance(v, int)]
+                if vals is not None else None)
+        if not ints:
+            raise _EvalError(de)
+        per_dim.append(ints)
+
+    groups = sorted({g for g in joint_syms.values()})
+    axes: List[List] = []
+    for i, de in enumerate(buf.dims):
+        if i in joint_syms:
+            axes.append([("joint", joint_syms[i], de.id)])
+        else:
+            axes.append(per_dim[i])
+    out: List[Tuple[int, ...]] = []
+    group_points = [rs.joint[g][1] for g in groups]
+    group_syms = [rs.joint[g][0] for g in groups]
+    for jp in itertools.islice(
+            itertools.product(*group_points) if groups else [()],
+            _MAX_POINTS):
+        env: Dict[str, int] = {}
+        for syms, point in zip(group_syms, jp):
+            env.update({s: v for s, v in zip(syms, point)
+                        if isinstance(v, int)})
+        dim_axes = []
+        ok = True
+        for i, ax in enumerate(axes):
+            if i in joint_syms:
+                sym = buf.dims[i].id
+                if sym not in env:
+                    ok = False
+                    break
+                dim_axes.append([env[sym]])
+            else:
+                dim_axes.append(ax)
+        if not ok:
+            raise _EvalError(buf.dims[i])
+        for pt in itertools.islice(itertools.product(*dim_axes),
+                                   _MAX_POINTS):
+            out.append(tuple(pt))
+    uniq = []
+    for p in out:
+        if p not in uniq:
+            uniq.append(p)
+    return uniq[:_MAX_POINTS]
+
+
+def _is_pure_const(rs: _SiteResolver, expr: ast.expr) -> bool:
+    return const_value(rs.module, expr, rs.project) is not None
+
+
+def _site_model(module: ModuleInfo, project):
+    """Memoized per-module site extraction + resolver construction."""
+    key = id(project)
+    cached = getattr(module, "_km_model", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    model = []
+    if "pallas_call" in module.source:
+        for site in _extract_sites(module):
+            rs = _SiteResolver(module, site.scope, project)
+            model.append((site, rs))
+    module._km_model = (key, model)
+    return model
+
+
+# ---------------------------------------------------------------------------
+# GLT017 vmem-budget-exceeded
+# ---------------------------------------------------------------------------
+
+@register
+class VmemBudgetExceeded(Rule):
+    """Closed-form VMEM accounting over every candidate parameter point."""
+    name = "vmem-budget-exceeded"
+    code = "GLT017"
+    severity = Severity.ERROR
+    description = ("a pallas_call's tiles + ring slots + scratch exceed "
+                   "the VMEM budget at some candidate parameter point "
+                   "(or a buffer dim is not statically boundable)")
+
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
+        findings: List[Finding] = []
+        model = _site_model(module, project)
+        if not model:
+            return findings
+        budget = _limits(module, project)["VMEM_BYTES"]
+        for site, rs in model:
+            total = 0
+            parts = []
+            swept: Dict[str, int] = {}
+            bad = False
+            for buf in site.buffers:
+                try:
+                    points = _buffer_points(buf, rs)
+                except _EvalError as e:
+                    findings.append(self.finding(
+                        module, buf.node,
+                        f"VMEM model cannot bound {buf.kind} dim "
+                        f"'{ast.unparse(e.dim_expr)}' of this pallas_call"
+                        f" — route it through a resolvable constant or "
+                        f"declare it in {_DOMAIN_NAME} so the closed-"
+                        f"form accounting stays total"))
+                    bad = True
+                    continue
+                itemsize = _ITEMSIZE.get(buf.dtype or "float32", 4)
+                mult = 2 if buf.pipelined else 1
+                worst, worst_pt = 0, None
+                for pt in points:
+                    b = mult * itemsize
+                    for v in pt:
+                        b *= max(v, 0)
+                    if b > worst:
+                        worst, worst_pt = b, pt
+                total += worst
+                if worst_pt is not None:
+                    shape = "x".join(str(v) for v in worst_pt)
+                    pre = "2x " if mult == 2 else ""
+                    parts.append(f"{buf.kind} {pre}[{shape}] "
+                                 f"{buf.dtype or 'f32(assumed)'} = "
+                                 f"{_fmt_bytes(worst)}")
+                    for de, v in zip(buf.dims, worst_pt):
+                        if isinstance(de, ast.Name) \
+                                and not _is_pure_const(rs, de):
+                            swept.setdefault(de.id, v)
+            if bad or total <= budget:
+                continue
+            at = ", ".join(f"{k}={v}" for k, v in sorted(swept.items()))
+            findings.append(self.finding(
+                module, site.call,
+                f"VMEM model: {' + '.join(parts)} = {_fmt_bytes(total)} "
+                f"exceeds the {_fmt_bytes(budget)} budget"
+                + (f" at candidate point {at}" if at else "")
+                + " — shrink the tile/ring point or drop it from the "
+                  "sweep table"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# GLT018 unbalanced-dma-ring
+# ---------------------------------------------------------------------------
+
+def _flatten_conjuncts(expr: ast.expr) -> List[ast.expr]:
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.BitAnd):
+        return (_flatten_conjuncts(expr.left)
+                + _flatten_conjuncts(expr.right))
+    if isinstance(expr, ast.BoolOp) and isinstance(expr.op, ast.And):
+        out = []
+        for v in expr.values:
+            out.extend(_flatten_conjuncts(v))
+        return out
+    return [expr]
+
+
+def _canon(expr: ast.expr, loop_vars: Set[str]) -> str:
+    """Canonical guard string: loop-index arithmetic collapses to '@' so
+    the fill prologue and steady state compare equal."""
+    if isinstance(expr, ast.Name):
+        return "@" if expr.id in loop_vars else expr.id
+    if isinstance(expr, ast.Constant):
+        return repr(expr.value)
+    if isinstance(expr, ast.BinOp):
+        left = _canon(expr.left, loop_vars)
+        right = _canon(expr.right, loop_vars)
+        if "@" in (left, right) and type(expr.op) in _NUM_BINOPS:
+            return "@"
+        return f"({left} {type(expr.op).__name__} {right})"
+    if isinstance(expr, ast.UnaryOp):
+        inner = _canon(expr.operand, loop_vars)
+        return inner if inner == "@" else \
+            f"({type(expr.op).__name__} {inner})"
+    if isinstance(expr, ast.Compare):
+        parts = [_canon(expr.left, loop_vars)]
+        for op, cmp in zip(expr.ops, expr.comparators):
+            parts.append(type(op).__name__)
+            parts.append(_canon(cmp, loop_vars))
+        return " ".join(parts)
+    if isinstance(expr, ast.Subscript):
+        return (f"{_canon(expr.value, loop_vars)}"
+                f"[{_canon(expr.slice, loop_vars)}]")
+    if isinstance(expr, ast.Attribute):
+        return f"{_canon(expr.value, loop_vars)}.{expr.attr}"
+    if isinstance(expr, ast.Call):
+        args = ", ".join(_canon(a, loop_vars) for a in expr.args)
+        return f"{_canon(expr.func, loop_vars)}({args})"
+    try:
+        return ast.unparse(expr)
+    except Exception:  # pragma: no cover - exotic nodes
+        return type(expr).__name__
+
+
+class _RingEvent:
+    __slots__ = ("kind", "node", "helper", "data_guards", "guard_src")
+
+    def __init__(self, kind, node, helper, data_guards, guard_src):
+        self.kind = kind
+        self.node = node
+        self.helper = helper
+        self.data_guards = data_guards   # set of canonical strings
+        self.guard_src = guard_src       # {canon: source text}
+
+
+def _loop_vars(unit: ast.AST, module: ModuleInfo) -> Set[str]:
+    out: Set[str] = set()
+    body_defs: Set[str] = set()
+    for node in ast.walk(unit):
+        if isinstance(node, ast.For):
+            t = node.target
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                out |= {e.id for e in t.elts if isinstance(e, ast.Name)}
+        elif isinstance(node, ast.Call):
+            name = module.call_name(node)
+            if name in _LOOPS:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        body_defs.add(arg.id)
+    for node in ast.walk(unit):
+        if isinstance(node, ast.FunctionDef) and node.name in body_defs \
+                and node.args.args:
+            out.add(node.args.args[0].arg)
+    return out
+
+
+def _dma_helpers(unit: ast.AST, module: ModuleInfo) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(unit):
+        if isinstance(node, ast.FunctionDef):
+            for stmt in ast.walk(node):
+                if isinstance(stmt, ast.Return) \
+                        and isinstance(stmt.value, ast.Call) \
+                        and module.call_name(stmt.value) == _ASYNC_COPY:
+                    out.add(node.name)
+    return out
+
+
+def _event_guards(node: ast.AST, unit: ast.AST, module: ModuleInfo,
+                  loop_vars: Set[str]):
+    """Data-dependent guard conjuncts between an event and its unit."""
+    data: Set[str] = set()
+    src: Dict[str, str] = {}
+    cur = module.parents.get(node)
+    while cur is not None and cur is not unit:
+        preds: List[ast.expr] = []
+        if isinstance(cur, ast.If):
+            preds.append(cur.test)
+        elif isinstance(cur, ast.FunctionDef):
+            for dec in cur.decorator_list:
+                if isinstance(dec, ast.Call) \
+                        and module.call_name(dec) == _WHEN and dec.args:
+                    preds.append(dec.args[0])
+        for pred in preds:
+            for conj in _flatten_conjuncts(pred):
+                if any(isinstance(n, ast.Subscript)
+                       for n in ast.walk(conj)):
+                    c = _canon(conj, loop_vars)
+                    data.add(c)
+                    try:
+                        src.setdefault(c, ast.unparse(conj))
+                    except Exception:  # pragma: no cover
+                        src.setdefault(c, c)
+        cur = module.parents.get(cur)
+    return data, src
+
+
+def _ring_units(module: ModuleInfo):
+    """(unit scope, events) for every top-level function owning a ring."""
+    for scope in module.scopes:
+        if scope.parent is not None:
+            continue
+        unit = scope.node
+        helpers = _dma_helpers(unit, module)
+        loop_vars = _loop_vars(unit, module)
+        events: List[_RingEvent] = []
+        for node in ast.walk(unit):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("start", "wait")):
+                continue
+            base = node.func.value
+            helper = None
+            if isinstance(base, ast.Call):
+                if module.call_name(base) == _ASYNC_COPY:
+                    helper = "<inline>"
+                elif isinstance(base.func, ast.Name) \
+                        and base.func.id in helpers:
+                    helper = base.func.id
+            if helper is None:
+                continue
+            data, src = _event_guards(node, unit, module, loop_vars)
+            events.append(_RingEvent(node.func.attr, node, helper,
+                                     data, src))
+        if events:
+            yield scope, events
+
+
+@register
+class UnbalancedDmaRing(Rule):
+    """Async-copy start/wait pairs must agree on row-skip predicates."""
+    name = "unbalanced-dma-ring"
+    code = "GLT018"
+    severity = Severity.ERROR
+    description = ("a make_async_copy start without a matching wait "
+                   "(or a data-dependent predicate guarding one side "
+                   "only): skipped rows leave dangling DMAs or waits "
+                   "on never-signaled semaphores")
+
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
+        findings: List[Finding] = []
+        if "make_async_copy" not in module.source:
+            return findings
+        for scope, events in _ring_units(module):
+            by_helper: Dict[str, List[_RingEvent]] = {}
+            for ev in events:
+                by_helper.setdefault(ev.helper, []).append(ev)
+            for helper, evs in sorted(by_helper.items()):
+                starts = [e for e in evs if e.kind == "start"]
+                waits = [e for e in evs if e.kind == "wait"]
+                ring = (f"DMA ring '{helper}'" if helper != "<inline>"
+                        else "inline make_async_copy")
+                if starts and not waits:
+                    findings.append(self.finding(
+                        module, starts[0].node,
+                        f"{ring} in '{scope.name}' is started but never "
+                        f"awaited — the in-flight DMA dangles and "
+                        f"corrupts its slot on reuse"))
+                    continue
+                if waits and not starts:
+                    findings.append(self.finding(
+                        module, waits[0].node,
+                        f"{ring} in '{scope.name}' is awaited but never "
+                        f"started — the wait blocks forever on a "
+                        f"never-signaled semaphore"))
+                    continue
+                data_s = set().union(*(e.data_guards for e in starts)) \
+                    if starts else set()
+                data_w = set().union(*(e.data_guards for e in waits)) \
+                    if waits else set()
+                srcs: Dict[str, str] = {}
+                for e in evs:
+                    srcs.update(e.guard_src)
+                for c in sorted(data_s - data_w):
+                    anchor = next(e.node for e in starts
+                                  if c in e.data_guards)
+                    findings.append(self.finding(
+                        module, anchor,
+                        f"{ring} in '{scope.name}': data-dependent "
+                        f"predicate '{srcs.get(c, c)}' guards start but "
+                        f"no wait shares it — a row skipped at start "
+                        f"leaves its unconditional wait blocking on a "
+                        f"never-signaled semaphore; guard start and "
+                        f"wait with the same row predicate"))
+                for c in sorted(data_w - data_s):
+                    anchor = next(e.node for e in waits
+                                  if c in e.data_guards)
+                    findings.append(self.finding(
+                        module, anchor,
+                        f"{ring} in '{scope.name}': data-dependent "
+                        f"predicate '{srcs.get(c, c)}' guards wait but "
+                        f"no start shares it — rows skipped at wait "
+                        f"leave their started DMA dangling on the ring "
+                        f"slot; guard start and wait with the same row "
+                        f"predicate"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# GLT019 unaligned-tile-shape
+# ---------------------------------------------------------------------------
+
+@register
+class UnalignedTileShape(Rule):
+    """VMEM blocks must tile the (sublane, 128-lane) register."""
+    name = "unaligned-tile-shape"
+    code = "GLT019"
+    severity = Severity.ERROR
+    description = ("a VMEM block/scratch shape whose last dim is not a "
+                   "multiple of the 128-lane register, or whose sublane "
+                   "dim violates the dtype's floor (f32 8 / bf16 16 / "
+                   "int8 32)")
+
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
+        findings: List[Finding] = []
+        model = _site_model(module, project)
+        if not model:
+            return findings
+        lims = _limits(module, project)
+        lane = lims["LANE"]
+        for site, rs in model:
+            for buf in site.buffers:
+                try:
+                    points = _buffer_points(buf, rs)
+                except _EvalError:
+                    continue          # GLT017 already reports it
+                floor = _sublane_floor(buf.dtype, lims["SUBLANE_F32"])
+                bad_lane = sorted({pt[-1] for pt in points
+                                   if pt[-1] % lane != 0})
+                bad_sub = sorted({pt[-2] for pt in points
+                                  if len(pt) >= 2 and pt[-2] % floor})
+                dt = buf.dtype or "f32(assumed)"
+                if bad_lane:
+                    findings.append(self.finding(
+                        module, buf.node,
+                        f"{buf.kind} last dim {bad_lane} is not a "
+                        f"multiple of the {lane}-lane register — Mosaic "
+                        f"pads every row to {lane} lanes (wasted VMEM "
+                        f"and misaligned DMAs); pad the trailing dim or "
+                        f"restructure the block"))
+                if bad_sub:
+                    findings.append(self.finding(
+                        module, buf.node,
+                        f"{buf.kind} sublane dim {bad_sub} violates the "
+                        f"{floor}-sublane floor for {dt} — the compiler "
+                        f"pads each tile up to ({floor}, {lane}); round "
+                        f"the dim up to a multiple of {floor}"))
+        return findings

@@ -1621,7 +1621,11 @@ def all_rules() -> List[Rule]:
     return [cls() for cls in RULES.values()]
 
 
-# The concurrency rules (GLT008/GLT009) live in their own module but
-# register into the same RULES table; importing here completes the
-# registry for every entry point (cli, tests, programmatic use).
+# The concurrency rules (GLT008/GLT009), the Pallas device-program model
+# (GLT017-019, kernelmodel.py), and the shard_map collective checks
+# (GLT020/021, spmd.py) live in their own modules but register into the
+# same RULES table; importing here completes the registry for every
+# entry point (cli, tests, programmatic use).
 from . import concurrency  # noqa: E402,F401  (registration side effect)
+from . import kernelmodel  # noqa: E402,F401  (registration side effect)
+from . import spmd  # noqa: E402,F401  (registration side effect)

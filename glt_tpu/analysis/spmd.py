@@ -1,0 +1,490 @@
+"""SPMD collective verification inside shard_map bodies: GLT020/021.
+
+Every ``jax.shard_map`` body in ``parallel/`` is one SPMD program: all
+shards execute the same trace, and every collective (``lax.psum``,
+``lax.all_to_all``, ``lax.ppermute``, ...) is a rendezvous — a shard
+that skips one leaves the others blocked in the runtime with no Python
+frame to debug.  Two hazards are statically checkable:
+
+* **GLT020 divergent-collective** — a collective under control flow
+  (``lax.cond`` / ``lax.switch`` / Python ``if`` / ``lax.while_loop``)
+  whose predicate data-depends on a *shard-local* value.  Shard-local
+  taint seeds from ``lax.axis_index`` results and propagates through
+  assignments; values that pass through a *replicating* collective
+  (``psum``/``pmean``/``pmax``/``pmin``/``all_gather``) are uniform
+  again and launder the taint — the ``nvalid = psum(...)`` skip-step
+  guard in dist_train is the calibrated negative.  Findings carry the
+  dependence chain (variable, axis_index origin line) because the
+  deadlock reproduces only on multi-shard hardware.
+
+* **GLT021 unknown-axis-name** — a collective or ``PartitionSpec``
+  whose ``axis_name`` does not resolve to an axis bound by the
+  enclosing ``shard_map``'s mesh.  Axis sets come from ``Mesh(...,
+  axis_names)`` / ``jax.make_mesh`` construction; parametrically-built
+  meshes (``multihost.global_mesh(axis_name)``) are *open* and produce
+  no findings — only a literal/constant mismatch (the classic renamed
+  ``('host', 'chip')`` refactor leaving a stale ``'shard'`` string)
+  fires.  String constants resolve through the project symbol table
+  (module constants included), matching the engine's calibrated-quiet
+  contract: unresolvable means silent, not worst-case.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .kernelmodel import const_value
+from .report import Finding, Severity
+from .rules import Rule, register
+from .symbols import FunctionSymbol
+from .visitor import (
+    SHARD_MAP_NAMES,
+    FunctionScope,
+    ModuleInfo,
+    _unwrap_traced_target,
+)
+
+# canonical collective name -> index of its axis_name argument
+_COLLECTIVES = {
+    "jax.lax.psum": 1,
+    "jax.lax.pmean": 1,
+    "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1,
+    "jax.lax.all_gather": 1,
+    "jax.lax.psum_scatter": 1,
+    "jax.lax.all_to_all": 1,
+    "jax.lax.ppermute": 1,
+    "jax.lax.pshuffle": 1,
+}
+# Collectives whose *result* is identical on every shard: they launder
+# shard-local taint (psum_scatter/ppermute/all_to_all do NOT — their
+# outputs differ per shard).
+_REPLICATING = {
+    "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+    "jax.lax.all_gather",
+}
+_COND_NAMES = {"jax.lax.cond", "jax.lax.switch"}
+_WHILE = "jax.lax.while_loop"
+_FORI = "jax.lax.fori_loop"
+_MESH_NAMES = {"jax.sharding.Mesh", "jax.interpreters.pxla.Mesh",
+               "jax.experimental.maps.Mesh"}
+_MAKE_MESH = {"jax.make_mesh", "jax.sharding.make_mesh"}
+_PSPEC_NAMES = {"jax.sharding.PartitionSpec"}
+
+
+def _is_axis_index(module: ModuleInfo, node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and module.call_name(node) == "jax.lax.axis_index")
+
+
+def _collective_calls(module: ModuleInfo, root: ast.AST
+                      ) -> List[Tuple[ast.Call, str, int]]:
+    out = []
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call):
+            name = module.call_name(node)
+            if name in _COLLECTIVES:
+                out.append((node, name, _COLLECTIVES[name]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GLT020 divergent-collective
+# ---------------------------------------------------------------------------
+
+def _assign_names(target: ast.expr) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in target.elts:
+            out.extend(_assign_names(el))
+        return out
+    return []
+
+
+def _tainted_reads(module: ModuleInfo, expr: ast.AST, taint: Set[str]
+                   ) -> Optional[str]:
+    """First tainted Name read in ``expr``, skipping subtrees whose value
+    is replicated by a reducing collective (taint laundering)."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Call):
+            name = module.call_name(node)
+            if name in _REPLICATING:
+                continue            # uniform result: do not descend
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in taint:
+            return node.id
+        stack.extend(ast.iter_child_nodes(node))
+    return None
+
+
+def _unit_taint(module: ModuleInfo, unit: ast.AST
+                ) -> Dict[str, Tuple[str, int]]:
+    """Shard-local variables in a top-level scope's whole subtree:
+    ``{name: (seed description, seed line)}``.  Seeded by
+    ``lax.axis_index`` results, propagated through assignments (nested
+    defs included — closures share the namespace)."""
+    origin: Dict[str, Tuple[str, int]] = {}
+    for node in ast.walk(unit):
+        if isinstance(node, ast.Assign):
+            for sub in ast.walk(node.value):
+                if _is_axis_index(module, sub):
+                    for name in _assign_names(node.targets[0]) if \
+                            len(node.targets) == 1 else \
+                            [n for t in node.targets
+                             for n in _assign_names(t)]:
+                        origin.setdefault(
+                            name, (f"lax.axis_index at line {sub.lineno}",
+                                   sub.lineno))
+                    break
+    for _ in range(3):               # shallow chains; fixpoint fast
+        changed = False
+        for node in ast.walk(unit):
+            if not isinstance(node, ast.Assign):
+                continue
+            hit = _tainted_reads(module, node.value, set(origin))
+            if hit is None:
+                continue
+            for t in node.targets:
+                for name in _assign_names(t):
+                    if name not in origin:
+                        origin[name] = (
+                            f"'{hit}' <- {origin[hit][0]}",
+                            origin[hit][1])
+                        changed = True
+        if not changed:
+            break
+    return origin
+
+
+def _scope_by_name(module: ModuleInfo, unit: ast.AST,
+                   name: str) -> Optional[ast.AST]:
+    for node in ast.walk(unit):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    for node in module.tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def _branch_bodies(module: ModuleInfo, unit: ast.AST,
+                   exprs: List[ast.expr]) -> List[ast.AST]:
+    out: List[ast.AST] = []
+    for e in exprs:
+        if isinstance(e, ast.Lambda):
+            out.append(e.body)
+        elif isinstance(e, ast.Name):
+            fn = _scope_by_name(module, unit, e.id)
+            if fn is not None:
+                out.append(fn)
+        elif isinstance(e, ast.Call):  # partial(fn, ...) and friends
+            out.append(e)
+    return out
+
+
+def _has_collective(module: ModuleInfo, roots: List[ast.AST]) -> bool:
+    return any(_collective_calls(module, r) for r in roots)
+
+
+@register
+class DivergentCollective(Rule):
+    """Collectives under shard-dependent control flow deadlock."""
+    name = "divergent-collective"
+    code = "GLT020"
+    severity = Severity.ERROR
+    description = ("a collective under lax.cond/switch/while or Python "
+                   "control flow whose predicate depends on a "
+                   "shard-local value (lax.axis_index taint): shards "
+                   "diverge and the rendezvous deadlocks")
+
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
+        findings: List[Finding] = []
+        if "axis_index" not in module.source:
+            return findings
+        for scope in module.scopes:
+            if scope.parent is not None:
+                continue
+            unit = scope.node
+            taint = _unit_taint(module, unit)
+            if not taint:
+                continue
+            findings.extend(self._check_unit(module, unit, taint))
+        return findings
+
+    def _flag(self, module, node, pred_text, hit, taint, where):
+        desc, line = taint[hit]
+        return self.finding(
+            module, node,
+            f"collective inside {where} whose predicate "
+            f"'{pred_text}' depends on shard-local '{hit}' "
+            f"({desc}, seeded at line {line}): shards take different "
+            f"branches and the collective rendezvous deadlocks — hoist "
+            f"the collective out of the branch or make the predicate "
+            f"uniform (reduce it with psum/pmax first)")
+
+    def _check_unit(self, module: ModuleInfo, unit: ast.AST,
+                    taint: Dict[str, Tuple[str, int]]) -> List[Finding]:
+        findings: List[Finding] = []
+        names = set(taint)
+        for node in ast.walk(unit):
+            if isinstance(node, (ast.If, ast.While)):
+                hit = _tainted_reads(module, node.test, names)
+                if hit is None:
+                    continue
+                bodies: List[ast.AST] = list(node.body) + list(node.orelse)
+                if _has_collective(module, bodies):
+                    findings.append(self._flag(
+                        module, node, ast.unparse(node.test), hit, taint,
+                        "a Python branch"))
+            elif isinstance(node, ast.Call):
+                name = module.call_name(node)
+                if name in _COND_NAMES and node.args:
+                    hit = _tainted_reads(module, node.args[0], names)
+                    if hit is None:
+                        continue
+                    branches = _branch_bodies(module, unit, node.args[1:])
+                    if _has_collective(module, branches):
+                        findings.append(self._flag(
+                            module, node, ast.unparse(node.args[0]), hit,
+                            taint, name.rsplit('.', 1)[-1]))
+                elif name == _WHILE and len(node.args) >= 2:
+                    cond = _branch_bodies(module, unit, node.args[:1])
+                    hit = None
+                    for c in cond:
+                        hit = _tainted_reads(module, c, names)
+                        if hit:
+                            break
+                    if hit is None:
+                        continue
+                    body = _branch_bodies(module, unit, node.args[1:2])
+                    if _has_collective(module, body):
+                        findings.append(self._flag(
+                            module, node,
+                            ast.unparse(node.args[0]), hit, taint,
+                            "lax.while_loop (shard-dependent trip "
+                            "count)"))
+                elif name == _FORI and len(node.args) >= 3:
+                    hit = (_tainted_reads(module, node.args[0], names)
+                           or _tainted_reads(module, node.args[1], names))
+                    if hit is None:
+                        continue
+                    body = _branch_bodies(module, unit, node.args[2:3])
+                    if _has_collective(module, body):
+                        findings.append(self._flag(
+                            module, node,
+                            ast.unparse(node.args[0]) + ", "
+                            + ast.unparse(node.args[1]), hit, taint,
+                            "lax.fori_loop (shard-dependent trip "
+                            "count)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# GLT021 unknown-axis-name
+# ---------------------------------------------------------------------------
+
+def _axis_literal(module: ModuleInfo, expr: Optional[ast.expr],
+                  project) -> Optional[Set[str]]:
+    """Axis names an expression statically resolves to, else None
+    (parametric/unknown — calibrated-quiet)."""
+    if expr is None:
+        return None
+    val = const_value(module, expr, project)
+    if isinstance(val, str):
+        return {val}
+    if isinstance(val, tuple) and val \
+            and all(isinstance(v, str) for v in val):
+        return set(val)
+    return None
+
+
+def _mesh_axes(module: ModuleInfo, scope, expr: ast.expr,
+               project) -> Optional[Set[str]]:
+    """Axis set bound by a mesh expression, else None (open mesh)."""
+    call = expr
+    if isinstance(expr, ast.Name):
+        cur = scope
+        while cur is not None:
+            for node in ast.walk(cur.node):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == expr.id
+                        for t in node.targets):
+                    call = node.value
+            cur = cur.parent
+        if call is expr:
+            for node in module.tree.body:
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == expr.id
+                        for t in node.targets):
+                    call = node.value
+    if not isinstance(call, ast.Call):
+        return None
+    name = module.call_name(call)
+    axis_expr: Optional[ast.expr] = None
+    if name in _MESH_NAMES and len(call.args) >= 2:
+        axis_expr = call.args[1]
+    elif name in _MESH_NAMES:
+        for kw in call.keywords:
+            if kw.arg == "axis_names":
+                axis_expr = kw.value
+    elif name in _MAKE_MESH:
+        axis_expr = (call.args[1] if len(call.args) >= 2 else None)
+        if axis_expr is None:
+            for kw in call.keywords:
+                if kw.arg == "axis_names":
+                    axis_expr = kw.value
+    else:
+        return None
+    return _axis_literal(module, axis_expr, project)
+
+
+def _axis_params(fn: ast.FunctionDef, module: ModuleInfo) -> Set[str]:
+    """Parameter names a function forwards as collective axis args."""
+    params = {a.arg for a in fn.args.args + fn.args.kwonlyargs
+              + fn.args.posonlyargs}
+    out: Set[str] = set()
+    for call, _, axis_pos in _collective_calls(module, fn):
+        axis = (call.args[axis_pos] if len(call.args) > axis_pos
+                else next((k.value for k in call.keywords
+                           if k.arg == "axis_name"), None))
+        if isinstance(axis, ast.Name) and axis.id in params:
+            out.add(axis.id)
+    return out
+
+
+def _call_literal_bindings(call: ast.Call, fn: ast.FunctionDef
+                           ) -> Dict[str, ast.expr]:
+    """Callee-param -> literal-string argument bindings at a call site."""
+    pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    out: Dict[str, ast.expr] = {}
+    for i, arg in enumerate(call.args):
+        if i < len(pos):
+            out[pos[i]] = arg
+    for kw in call.keywords:
+        if kw.arg:
+            out[kw.arg] = kw.value
+    return out
+
+
+@register
+class UnknownAxisName(Rule):
+    """Collective/PartitionSpec axes must exist on the bound mesh."""
+    name = "unknown-axis-name"
+    code = "GLT021"
+    severity = Severity.ERROR
+    description = ("a collective or PartitionSpec inside shard_map "
+                   "names an axis the bound mesh does not define "
+                   "(stale string after a mesh-axis rename); "
+                   "parametric meshes are skipped")
+
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
+        findings: List[Finding] = []
+        if "shard_map" not in module.source and \
+                "xmap" not in module.source:
+            return findings
+        # Walk each shard_map call exactly once, with its owning scope.
+        seen: Set[int] = set()
+        for scope in module.scopes:
+            for node in ast.walk(scope.node):
+                if id(node) in seen:
+                    continue
+                if isinstance(node, ast.Call) \
+                        and module.call_name(node) in SHARD_MAP_NAMES:
+                    seen.add(id(node))
+                    findings.extend(self._check_site(
+                        module, scope, node, project))
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and id(node) not in seen \
+                    and module.call_name(node) in SHARD_MAP_NAMES:
+                findings.extend(self._check_site(
+                    module, None, node, project))
+        return findings
+
+    def _check_site(self, module: ModuleInfo,
+                    scope: Optional[FunctionScope], call: ast.Call,
+                    project) -> List[Finding]:
+        mesh_expr = next((k.value for k in call.keywords
+                          if k.arg == "mesh"),
+                         call.args[1] if len(call.args) > 1 else None)
+        if mesh_expr is None:
+            return []
+        axes = _mesh_axes(module, scope, mesh_expr, project)
+        if axes is None:
+            return []                      # open mesh: stay quiet
+        findings: List[Finding] = []
+
+        def check_axis(node, expr, what):
+            names = _axis_literal(module, expr, project)
+            if names is None:
+                return
+            missing = sorted(names - axes)
+            if missing:
+                findings.append(self.finding(
+                    module, node,
+                    f"{what} names axis {missing} but the enclosing "
+                    f"shard_map's mesh binds only "
+                    f"{sorted(axes)} — every shard would wait on a "
+                    f"rendezvous over an axis that does not exist "
+                    f"(stale axis string after a mesh rename?)"))
+
+        # PartitionSpec literals in the in_specs/out_specs expressions.
+        for kw in call.keywords:
+            if kw.arg not in ("in_specs", "out_specs"):
+                continue
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Call) \
+                        and module.call_name(sub) in _PSPEC_NAMES:
+                    for arg in sub.args:
+                        check_axis(sub, arg, "PartitionSpec")
+
+        # Collectives in the traced body (nested defs included), plus
+        # one transitive step into project functions the body calls
+        # with literal axis strings.
+        target = _unwrap_traced_target(call, module.imports)
+        body: Optional[ast.AST] = None
+        if isinstance(target, ast.Lambda):
+            body = target.body
+        elif isinstance(target, ast.Name):
+            unit = scope.node if scope is not None else module.tree
+            body = _scope_by_name(module, unit, target.id)
+        elif (isinstance(target, ast.Attribute)
+              and isinstance(target.value, ast.Name)
+              and target.value.id == "self"):
+            body = _scope_by_name(module, module.tree, target.attr)
+        if body is None:
+            return findings
+        for coll, name, axis_pos in _collective_calls(module, body):
+            axis = (coll.args[axis_pos] if len(coll.args) > axis_pos
+                    else next((k.value for k in coll.keywords
+                               if k.arg == "axis_name"), None))
+            check_axis(coll, axis, name.rsplit(".", 1)[-1])
+        for sub in ast.walk(body):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn_def: Optional[ast.FunctionDef] = None
+            callee_mod = module
+            if project is not None:
+                sym = project.resolve_call(module, scope, sub)
+                if isinstance(sym, FunctionSymbol) and isinstance(
+                        sym.scope.node, ast.FunctionDef):
+                    fn_def = sym.scope.node
+                    callee_mod = sym.module
+            if fn_def is None and isinstance(sub.func, ast.Name):
+                got = _scope_by_name(module, module.tree, sub.func.id)
+                if isinstance(got, ast.FunctionDef):
+                    fn_def = got
+            if fn_def is None or fn_def is body:
+                continue
+            fwd = _axis_params(fn_def, callee_mod)
+            if not fwd:
+                continue
+            for param, arg in _call_literal_bindings(sub, fn_def).items():
+                if param in fwd:
+                    check_axis(sub, arg,
+                               f"axis argument '{param}' of "
+                               f"'{fn_def.name}'")
+        return findings

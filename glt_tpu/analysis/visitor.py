@@ -58,8 +58,14 @@ class ImportMap:
     def __init__(self) -> None:
         self._alias: Dict[str, str] = {}
 
-    def collect(self, tree: ast.AST, package: str = "") -> "ImportMap":
-        for node in ast.walk(tree):
+    def collect(self, tree_or_nodes, package: str = "") -> "ImportMap":
+        """Collect aliases from a whole tree, or from a pre-gathered
+        iterable of Import/ImportFrom nodes (ModuleInfo passes the list
+        from its single traversal so the tree is walked once, not per
+        consumer)."""
+        nodes = (ast.walk(tree_or_nodes)
+                 if isinstance(tree_or_nodes, ast.AST) else tree_or_nodes)
+        for node in nodes:
             if isinstance(node, ast.Import):
                 for a in node.names:
                     self._alias[a.asname or a.name.split(".")[0]] = (
@@ -317,11 +323,24 @@ class ModuleInfo:
             self.package = (self.name.rsplit(".", 1)[0]
                             if "." in self.name else "")
         self.tree = ast.parse(source, filename=path)
-        self.imports = ImportMap().collect(self.tree, package=self.package)
+        # One traversal feeds every downstream consumer: the parent map,
+        # the import table, and the call-site list _mark_jit_roots scans
+        # (full ast.walk per consumer dominated analysis setup time).
         self.parents: Dict[ast.AST, ast.AST] = {}
-        for parent in ast.walk(self.tree):
+        import_nodes: List[ast.stmt] = []
+        self._calls: List[ast.Call] = []
+        stack: List[ast.AST] = [self.tree]
+        while stack:
+            parent = stack.pop()
             for child in ast.iter_child_nodes(parent):
                 self.parents[child] = parent
+                stack.append(child)
+                if isinstance(child, ast.Call):
+                    self._calls.append(child)
+                elif isinstance(child, (ast.Import, ast.ImportFrom)):
+                    import_nodes.append(child)
+        self.imports = ImportMap().collect(import_nodes,
+                                           package=self.package)
         self.scopes: List[FunctionScope] = []
         self._scope_by_node: Dict[ast.AST, FunctionScope] = {}
         self._collect_scopes(self.tree, None, None)
@@ -380,9 +399,7 @@ class ModuleInfo:
         by_name: Dict[str, List[FunctionScope]] = {}
         for scope in self.scopes:
             by_name.setdefault(scope.name, []).append(scope)
-        for node in ast.walk(self.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in self._calls:
             name = self._resolve(node.func)
             if name not in JIT_NAMES and name not in SHARD_MAP_NAMES:
                 continue

@@ -41,16 +41,34 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import tpu_limits
 from .gather_pallas import gather_rows
 from .unique import unique_first_occurrence
 
 _CHUNK = 256
-_SUBLANE = 8
-# Unique-block VMEM budget: ~6 MB leaves headroom for the output chunk,
-# double-buffered DMA metadata, and whatever the surrounding scanned
-# step keeps live (VMEM is ~16 MB/core).
-DEFAULT_VMEM_BUDGET = 6 * 2**20
+_LANE = tpu_limits.LANE
+_SUBLANE = tpu_limits.SUBLANE_F32
+# Unique-block VMEM budget: 3/8 of the core's VMEM (~6 MB of 16) leaves
+# headroom for the output chunk, double-buffered DMA metadata, and
+# whatever the surrounding scanned step keeps live.  Derived from
+# tpu_limits so the runtime gate (fused_frontier_supported) and the
+# static model (analysis/kernelmodel.py GLT017) can never disagree.
+DEFAULT_VMEM_BUDGET = tpu_limits.VMEM_BYTES * 3 // 8
 _RING = 8
+
+# Dimension domain for the static VMEM model.  The scratch buffer is
+# [up, d] where both dims are runtime-sized but their PRODUCT is gated
+# by fused_frontier_supported (up * d * itemsize <= DEFAULT_VMEM_BUDGET),
+# so the model checks the gate's corner points jointly: at each feature
+# width, the deepest unique block the runtime gate admits.
+VMEM_MODEL_DOMAIN = {
+    ("up", "d"): (
+        (DEFAULT_VMEM_BUDGET // (tpu_limits.LANE * 4), tpu_limits.LANE),
+        (DEFAULT_VMEM_BUDGET // (512 * 4), 512),
+        (DEFAULT_VMEM_BUDGET // (tpu_limits.MODEL_MAX_LANES * 4),
+         tpu_limits.MODEL_MAX_LANES),
+    ),
+}
 
 
 class FusedFrontier(NamedTuple):
@@ -68,7 +86,7 @@ def fused_frontier_supported(table: jnp.ndarray, ids: jnp.ndarray,
     budget = DEFAULT_VMEM_BUDGET if vmem_budget is None else int(vmem_budget)
     d = int(table.shape[1])
     up = -(-int(ids.shape[0]) // _SUBLANE) * _SUBLANE
-    return d % 128 == 0 and up * d * table.dtype.itemsize <= budget
+    return d % _LANE == 0 and up * d * table.dtype.itemsize <= budget
 
 
 def _make_fused_kernel(up: int, nbuf: int, chunk: int):

@@ -58,8 +58,29 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import tpu_limits
+
 _CHUNK = 256  # output rows per grid step (batch padded to a multiple)
-_MIN_TILE = 8
+_LANE = tpu_limits.LANE
+_MIN_TILE = tpu_limits.SUBLANE_F32
+
+# The (tile_rows, ring_depth) grid the autotuner sweeps — and the grid
+# the static VMEM model (analysis/kernelmodel.py GLT017) verifies every
+# point of, via VMEM_MODEL_DOMAIN below.
+CANDIDATE_TILE_ROWS = (8, 16, 32)
+CANDIDATE_RING_DEPTHS = (4, 8)
+
+# Dimension domain for the static VMEM model: analysis/kernelmodel.py
+# resolves this dict through the symbol table and checks the closed-form
+# VMEM accounting of _gather_sorted_pallas at EVERY assignment of these
+# symbols against tpu_limits.VMEM_BYTES.  tile_rows/ring_depth are the
+# sweep axes (same tuples the autotuner crosses); `d` is the widest
+# feature row the kernel is modeled at.
+VMEM_MODEL_DOMAIN = {
+    "tile_rows": CANDIDATE_TILE_ROWS,
+    "ring_depth": CANDIDATE_RING_DEPTHS,
+    "d": tpu_limits.MODEL_MAX_LANES,
+}
 
 # Decision table for force='auto': (d, b, dtype) ->
 #   ("xla", None) | ("pallas", (tile_rows, ring_depth)).
@@ -74,8 +95,7 @@ _AUTO_TIMES: dict = {}
 def _sublane_min(dtype) -> int:
     """Smallest legal second-to-last tile dim for this dtype (f32 8,
     bf16 16, int8/fp8 32 — pallas_guide.md 'Tiling Constraints')."""
-    size = jnp.dtype(dtype).itemsize
-    return max(_MIN_TILE, 32 // max(size, 1))
+    return tpu_limits.sublane_min(jnp.dtype(dtype).itemsize)
 
 
 def default_gather_params(d: int, dtype=jnp.float32) -> tuple:
@@ -87,7 +107,8 @@ def default_gather_params(d: int, dtype=jnp.float32) -> tuple:
     latency to hide behind in-flight DMAs.
     """
     row_bytes = max(int(d) * jnp.dtype(dtype).itemsize, 1)
-    tile = max(_sublane_min(dtype), min(32, (1 << 14) // row_bytes))
+    tile = max(_sublane_min(dtype),
+               min(32, tpu_limits.DMA_DEPTH_TARGET_BYTES // row_bytes))
     tile = max(_MIN_TILE, (tile // _MIN_TILE) * _MIN_TILE)
     return tile, 8
 
@@ -97,8 +118,8 @@ def candidate_gather_params(d: int, dtype=jnp.float32) -> list:
     sweeps for one shape.  Small by design: 3 tile depths x 2 ring
     depths, pruned to legal sublane multiples for the dtype."""
     lo = _sublane_min(dtype)
-    tiles = sorted({t for t in (8, 16, 32) if t >= lo})
-    return [(t, r) for t in tiles for r in (4, 8)]
+    tiles = sorted({t for t in CANDIDATE_TILE_ROWS if t >= lo})
+    return [(t, r) for t in tiles for r in CANDIDATE_RING_DEPTHS]
 
 
 def _plan_tiled(idx: jnp.ndarray, n: int, tile: int):
@@ -237,7 +258,7 @@ def gather_rows_pallas(table: jnp.ndarray, idx: jnp.ndarray,
     """Gather ``table[idx]`` via coalesced block DMAs.
 
     Args:
-      table: ``[N, d]`` feature matrix (HBM-resident).  ``d % 128 == 0``
+      table: ``[N, d]`` feature matrix (HBM-resident).  ``d % _LANE == 0``
         runs natively; ``d == 64`` runs through the paired-row view
         (``N`` must be even); other widths raise.  ``N >= tile_rows``.
       idx: ``[B]`` int32 row ids; out-of-range/negative ids are clamped
@@ -253,13 +274,13 @@ def gather_rows_pallas(table: jnp.ndarray, idx: jnp.ndarray,
     # args) — no coercions here, so the transitive host-sync analysis
     # (GLT001) sees this body as jnp-pure from every traced caller.
     if tile_rows is None or ring_depth is None:
-        dt, dr = default_gather_params(d if d % 128 == 0 else 128,
+        dt, dr = default_gather_params(d if d % _LANE == 0 else 128,
                                        table.dtype)
         if tile_rows is None:
             # Defaults adapt to tiny tables: the deepest legal tile not
             # exceeding the table height (explicit tile_rows still
             # raises past the table — the autotuner relies on that).
-            rows = n if d % 128 == 0 else n // 2
+            rows = n if d % _LANE == 0 else n // 2
             tile_rows = max(_MIN_TILE,
                             min(dt, (rows // _MIN_TILE) * _MIN_TILE))
         if ring_depth is None:
@@ -268,7 +289,7 @@ def gather_rows_pallas(table: jnp.ndarray, idx: jnp.ndarray,
     idx_p = jnp.concatenate(
         [idx.astype(jnp.int32), jnp.zeros((bp - b,), jnp.int32)])
 
-    if d % 128 == 0:
+    if d % _LANE == 0:
         if n < tile_rows:
             raise ValueError(f"table rows {n} must be >= {tile_rows}")
         out = _gather_sorted_pallas(table, idx_p, interpret, tile_rows,
@@ -284,7 +305,7 @@ def gather_rows_pallas(table: jnp.ndarray, idx: jnp.ndarray,
             raise ValueError(
                 f"paired table rows {n // 2} must be >= {tile_rows}")
         idx_c = jnp.clip(idx_p, 0, n - 1)
-        paired = _gather_sorted_pallas(table.reshape(n // 2, 128),
+        paired = _gather_sorted_pallas(table.reshape(n // 2, _LANE),
                                        idx_c // 2, interpret, tile_rows,
                                        ring_depth)
         half = jnp.take_along_axis(
@@ -301,7 +322,7 @@ def _xla_gather(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
 def pallas_gather_supported(table, idx, tile_rows: int = _MIN_TILE) -> bool:
     """Shape constraints of the tiled kernel (dtype-agnostic)."""
     n, d = table.shape
-    if d % 128 == 0:
+    if d % _LANE == 0:
         return n >= tile_rows
     return d == 64 and n % 2 == 0 and n // 2 >= tile_rows
 

@@ -46,10 +46,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..typing import PADDING_ID
+from . import tpu_limits
 from .neighbor_sample import (NeighborOutput, _draw_positions,
                               _row_offsets_and_degrees)
 
-_LANE = 128
+_LANE = tpu_limits.LANE
 
 # Decision table for sample_neighbors(force='auto'):
 #   (batch, fanout, dtype) -> None (= xla) | (tile_rows, ring_depth,
@@ -60,6 +61,30 @@ _AUTO: dict = {}
 _AUTO_TIMES: dict = {}
 
 DEFAULT_BIN_EDGES = (64, 512)
+
+# The (tile_rows, ring_depth, bin_edges) grid :func:`autotune_sample`
+# sweeps — and the grid the static VMEM model (analysis/kernelmodel.py
+# GLT017) verifies every point of, via VMEM_MODEL_DOMAIN below.
+CANDIDATE_TILE_ROWS = (128, 256)
+CANDIDATE_RING_DEPTHS = (4, 8)
+CANDIDATE_BIN_EDGES = ((64, 512), (32, 256, 2048))
+
+# Widest fanout the static VMEM model assumes (production fanouts run
+# 5-25; the out block is [tile, fanout] so fanout bounds its lanes).
+MODEL_MAX_FANOUT = 64
+
+# Dimension domain for the static VMEM model: analysis/kernelmodel.py
+# resolves this dict through the symbol table and checks the closed-form
+# VMEM accounting of _binned_take_sorted at EVERY assignment of these
+# symbols against tpu_limits.VMEM_BYTES.  The per-bin window width `w`
+# is derived inside the function (`_bin_width(edge)` over the bin-edges
+# layout), so the domain only needs the sweep axes themselves.
+VMEM_MODEL_DOMAIN = {
+    "tile": CANDIDATE_TILE_ROWS,
+    "ring": CANDIDATE_RING_DEPTHS,
+    "bin_edges": CANDIDATE_BIN_EDGES,
+    "fanout": MODEL_MAX_FANOUT,
+}
 
 
 def _bin_width(edge: int) -> int:
@@ -82,9 +107,10 @@ def candidate_sample_params() -> list:
     shallow pair for near-uniform graphs and a three-class ladder whose
     top bin keeps power-law hubs off the XLA epilogue — crossed with the
     tile/ring depths that bound per-launch VMEM at ring * W * 4B."""
-    edge_opts = ((64, 512), (32, 256, 2048))
     return [(t, r, e)
-            for e in edge_opts for t in (128, 256) for r in (4, 8)]
+            for e in CANDIDATE_BIN_EDGES
+            for t in CANDIDATE_TILE_ROWS
+            for r in CANDIDATE_RING_DEPTHS]
 
 
 def pallas_sample_supported(indices: jnp.ndarray,
@@ -201,12 +227,26 @@ def _binned_take_sorted(src, binid_s, estart_s, off_s, pos_s, bin_edges,
             num_scalar_prefetch=3,
             grid=(bp // tile,),
             in_specs=[
+                # fanout (<=64) is deliberately narrower than the
+                # 128-lane register: Mosaic pads the row in-register and
+                # the padding cost (~2x on the [tile, fanout] blocks,
+                # still <1% of VMEM) beats doubling every descriptor and
+                # output buffer to a 128 stride end to end.
+                # gltlint: disable-next=unaligned-tile-shape
                 pl.BlockSpec((tile, fanout), lambda c, *_: (c, 0)),
                 pl.BlockSpec(memory_space=pltpu.ANY),
             ],
+            # Same narrow-fanout trade as the in block above.
+            # gltlint: disable-next=unaligned-tile-shape
             out_specs=pl.BlockSpec((tile, fanout), lambda c, *_: (c, 0),
                                    memory_space=pltpu.VMEM),
             scratch_shapes=[
+                # ring=4 sits under the 8-sublane int32 floor; the slots
+                # are row-granular DMA landing pads (never a tiled
+                # compute operand), so the floor costs padding only —
+                # deepening the ring to 8 would double live DMAs for no
+                # measured gain (ROADMAP item 1 sweep).
+                # gltlint: disable-next=unaligned-tile-shape
                 pltpu.VMEM((ring, w), jnp.int32),
                 pltpu.SemaphoreType.DMA((ring,)),
             ],

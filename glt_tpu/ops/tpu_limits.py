@@ -1,0 +1,50 @@
+"""TPU device limits: the single source of truth for kernel sizing.
+
+Every number here is a hardware (or hardware-adjacent) constant that
+both the Pallas kernels (``ops/``) and the static device-program
+verifier (``analysis/kernelmodel.py``) reason about.  Keeping them in
+one importable module means the kernels and the analyzer can never
+disagree: the analyzer resolves these names through its symbol table,
+so editing a value here re-checks every kernel against the new limit
+on the next lint run.
+
+Sources: pallas_guide.md "Tiling Constraints" / "Memory Spaces"
+(VMEM ~16 MB/core; min tile (sublane, lane) per dtype: float32 (8,128),
+bfloat16 (16,128), int8/fp8 (32,128)) and the DMA-depth calibration of
+gather_pallas.py round 5 (~16 KB block DMAs are where a v5-class DMA
+engine streams instead of paying setup per transfer).
+
+Stdlib-only on purpose: the analyzer's CI job runs without the JAX
+stack, and nothing below needs an array library.
+"""
+from __future__ import annotations
+
+# Per-core VMEM.  The hard ceiling the closed-form VMEM model
+# (GLT017 vmem-budget-exceeded) checks every candidate kernel
+# parameter point against.
+VMEM_BYTES = 16 * 2**20
+
+# Last-dimension register width: every VMEM tile is LANE lanes wide,
+# and narrower last dims are padded up to it.
+LANE = 128
+
+# Minimum second-to-last (sublane) tile dim by dtype width: 4-byte
+# types tile (8, 128), 2-byte (16, 128), 1-byte (32, 128).
+SUBLANE_F32 = 8
+SUBLANE_BF16 = 16
+SUBLANE_INT8 = 32
+
+# Block-DMA byte depth the width-specialized gather defaults aim for:
+# deep enough to stream, small enough to keep ring slots cheap.
+DMA_DEPTH_TARGET_BYTES = 1 << 14
+
+# Widest feature row (in lanes) the static VMEM model assumes for
+# runtime-sized last dims (a table's `d` is only known at trace time;
+# the model bounds it here so the closed-form accounting stays total).
+MODEL_MAX_LANES = 2048
+
+
+def sublane_min(itemsize: int) -> int:
+    """Smallest legal sublane tile dim for an ``itemsize``-byte dtype
+    (f32 8, bf16 16, int8/fp8 32 — pallas_guide.md)."""
+    return max(SUBLANE_F32, 32 // max(int(itemsize), 1))

@@ -1826,6 +1826,463 @@ class TestSuppression:
 
 
 # ---------------------------------------------------------------------------
+# GLT017 vmem-budget-exceeded
+# ---------------------------------------------------------------------------
+
+# Indented to match the fixture bodies it is concatenated with, so
+# textwrap.dedent (inside findings_for) strips a uniform prefix.
+PALLAS_HEADER = """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+"""
+
+
+class TestVmemBudgetExceeded:
+    def test_overflowing_scratch_fires(self):
+        src = PALLAS_HEADER + """
+        def kern(o_ref, buf):
+            o_ref[...] = buf[0]
+
+        def run(x):
+            return pl.pallas_call(
+                kern,
+                out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                scratch_shapes=[pltpu.VMEM((65536, 128), jnp.float32)],
+            )(x)
+        """
+        out = findings_for(src, "vmem-budget-exceeded")
+        assert len(out) == 1
+        assert out[0].severity is Severity.ERROR
+        assert "32.0MB" in out[0].message and "16.0MB" in out[0].message
+
+    def test_small_kernel_clean(self):
+        src = PALLAS_HEADER + """
+        def kern(o_ref, buf):
+            o_ref[...] = buf[0]
+
+        def run(x):
+            return pl.pallas_call(
+                kern,
+                out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                scratch_shapes=[pltpu.VMEM((128, 128), jnp.float32)],
+            )(x)
+        """
+        assert findings_for(src, "vmem-budget-exceeded") == []
+
+    def test_constant_resolution_dict_and_default(self):
+        """Dims resolve through a module constant, a function default,
+        and the module-level VMEM_MODEL_DOMAIN sweep dict; the finding
+        names the overflowing candidate point."""
+        src = PALLAS_HEADER + """
+        TILE = 256
+        VMEM_MODEL_DOMAIN = {"d": (128, 4096)}
+
+        def kern(o_ref, buf):
+            o_ref[...] = buf[0]
+
+        def run(x, ring=32):
+            return pl.pallas_call(
+                kern,
+                out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                scratch_shapes=[
+                    pltpu.VMEM((ring, TILE, d), jnp.float32)],
+            )(x)
+        """
+        out = findings_for(src, "vmem-budget-exceeded")
+        assert len(out) == 1
+        assert "d=4096" in out[0].message
+        assert "ring=32" in out[0].message
+        # every candidate point under budget -> clean
+        clean = src.replace('"d": (128, 4096)', '"d": (128,)')
+        clean = clean.replace("ring=32", "ring=4")
+        assert findings_for(clean, "vmem-budget-exceeded") == []
+
+    def test_unmodelable_dim_is_an_error(self):
+        """A dim the model cannot bound is itself a finding — the
+        accounting must stay total, and the fix (declare the domain) is
+        named in the message."""
+        src = PALLAS_HEADER + """
+        def kern(o_ref, buf):
+            o_ref[...] = buf[0]
+
+        def run(x, width):
+            return pl.pallas_call(
+                kern,
+                out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                scratch_shapes=[pltpu.VMEM((8, width), jnp.float32)],
+            )(x)
+        """
+        out = findings_for(src, "vmem-budget-exceeded")
+        assert len(out) == 1
+        assert "VMEM_MODEL_DOMAIN" in out[0].message
+        assert "width" in out[0].message
+
+    def test_gridded_blocks_count_double_buffered(self):
+        """With a grid, in/out blocks are pipeline double-buffered: a
+        5MB block models as 10MB and clears a 16MB budget only without
+        the x2."""
+        src = PALLAS_HEADER + """
+        def kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def run(x):
+            return pl.pallas_call(
+                kern,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((2048, 1024),
+                                       lambda c: (c, 0))],
+                out_specs=pl.BlockSpec((2048, 1024), lambda c: (c, 0)),
+                out_shape=jax.ShapeDtypeStruct((8192, 1024), jnp.float32),
+            )(x)
+        """
+        out = findings_for(src, "vmem-budget-exceeded")
+        assert len(out) == 1
+        assert "2x" in out[0].message
+
+    def test_budget_resolves_from_tpu_limits_module(self):
+        """The budget is the project's own ops/tpu_limits.py constant,
+        not a hardcoded analyzer copy."""
+        limits = "VMEM_BYTES = 1024\nLANE = 128\nSUBLANE_F32 = 8\n"
+        kern = PALLAS_HEADER + """
+        from . import tpu_limits
+
+        def kern(o_ref, buf):
+            o_ref[...] = buf[0]
+
+        def run(x):
+            return pl.pallas_call(
+                kern,
+                out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            )(x)
+        """
+        out = project_findings(
+            {"pkg.ops.tpu_limits": limits, "pkg.ops.kern": kern},
+            "vmem-budget-exceeded")
+        assert len(out) == 1            # 4KB out vs the 1KB budget
+        assert "1.0KB" in out[0].message
+
+
+# ---------------------------------------------------------------------------
+# GLT018 unbalanced-dma-ring
+# ---------------------------------------------------------------------------
+
+class TestUnbalancedDmaRing:
+    POS = PALLAS_HEADER + """
+        def make_kernel(nbuf):
+            def kernel(idx_ref, x_ref, o_ref, buf, sems):
+                def dma(j):
+                    return pltpu.make_async_copy(
+                        x_ref.at[pl.ds(j, 1)], buf.at[pl.ds(j, 1)],
+                        sems.at[lax.rem(j, nbuf)])
+
+                def body(j, c):
+                    @pl.when(idx_ref[j] >= 0)
+                    def _():
+                        dma(j).start()
+
+                    dma(j).wait()
+                    return c
+
+                lax.fori_loop(0, 8, body, None)
+            return kernel
+    """
+
+    def test_start_guard_without_matching_wait_guard(self):
+        out = findings_for(self.POS, "unbalanced-dma-ring")
+        assert len(out) == 1
+        assert "idx_ref[j] >= 0" in out[0].message
+        assert "never-signaled" in out[0].message
+
+    def test_symmetric_guards_clean(self):
+        src = self.POS.replace(
+            "dma(j).wait()",
+            "@pl.when(idx_ref[j] >= 0)\n"
+            "                    def _w():\n"
+            "                        dma(j).wait()")
+        assert findings_for(src, "unbalanced-dma-ring") == []
+
+    def test_ring_control_guards_are_exempt(self):
+        """The fill prologue legitimately guards start with `j + nbuf <
+        n` and nothing else — loop-index arithmetic is ring control, not
+        a row predicate, and must not fire."""
+        src = PALLAS_HEADER + """
+        def make_kernel(nbuf, n):
+            def kernel(x_ref, o_ref, buf, sems):
+                def dma(j):
+                    return pltpu.make_async_copy(
+                        x_ref.at[pl.ds(j, 1)], buf.at[pl.ds(j, 1)],
+                        sems.at[lax.rem(j, nbuf)])
+
+                for k in range(nbuf):
+                    @pl.when(k < n)
+                    def _():
+                        dma(k).start()
+
+                def body(j, c):
+                    dma(j).wait()
+
+                    @pl.when(j + nbuf < n)
+                    def _():
+                        dma(j + nbuf).start()
+
+                    return c
+
+                lax.fori_loop(0, n, body, None)
+            return kernel
+        """
+        assert findings_for(src, "unbalanced-dma-ring") == []
+
+    def test_start_without_any_wait(self):
+        src = PALLAS_HEADER + """
+        def make_kernel(nbuf):
+            def kernel(x_ref, o_ref, buf, sems):
+                def dma(j):
+                    return pltpu.make_async_copy(
+                        x_ref.at[pl.ds(j, 1)], buf.at[pl.ds(j, 1)],
+                        sems.at[j])
+
+                dma(0).start()
+                o_ref[...] = buf[...]
+            return kernel
+        """
+        out = findings_for(src, "unbalanced-dma-ring")
+        assert len(out) == 1
+        assert "never awaited" in out[0].message
+
+
+# ---------------------------------------------------------------------------
+# GLT019 unaligned-tile-shape
+# ---------------------------------------------------------------------------
+
+class TestUnalignedTileShape:
+    def test_lane_violation_fires(self):
+        src = PALLAS_HEADER + """
+        def kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def run(x):
+            return pl.pallas_call(
+                kern,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 100), lambda c: (c, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda c: (c, 0)),
+                out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+            )(x)
+        """
+        out = findings_for(src, "unaligned-tile-shape")
+        assert len(out) == 1
+        assert "128-lane" in out[0].message
+
+    def test_bf16_sublane_floor(self):
+        """bf16 packs two values per sublane row: the floor is 16, so an
+        (8, 128) bf16 scratch fires while the same f32 shape is clean."""
+        src = PALLAS_HEADER + """
+        def kern(o_ref, buf):
+            o_ref[...] = buf[...]
+
+        def run(x):
+            return pl.pallas_call(
+                kern,
+                out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                scratch_shapes=[pltpu.VMEM((8, 128), jnp.bfloat16)],
+            )(x)
+        """
+        out = findings_for(src, "unaligned-tile-shape")
+        assert len(out) == 1
+        assert "16-sublane floor for bfloat16" in out[0].message
+        clean = src.replace("jnp.bfloat16", "jnp.float32")
+        assert findings_for(clean, "unaligned-tile-shape") == []
+
+
+# ---------------------------------------------------------------------------
+# GLT020 divergent-collective
+# ---------------------------------------------------------------------------
+
+class TestDivergentCollective:
+    def test_cond_on_axis_index_with_collective(self):
+        src = """
+        from jax import lax
+
+        def body(x):
+            r = lax.axis_index("shard")
+            return lax.cond(r > 0,
+                            lambda v: lax.psum(v, "shard"),
+                            lambda v: v, x)
+        """
+        out = findings_for(src, "divergent-collective")
+        assert len(out) == 1
+        assert "'r'" in out[0].message
+        assert "lax.axis_index" in out[0].message    # dependence chain
+        assert "deadlock" in out[0].message
+
+    def test_taint_propagates_through_assignments(self):
+        src = """
+        from jax import lax
+
+        def body(x):
+            me = lax.axis_index("shard")
+            is_leader = me == 0
+            if is_leader:
+                x = lax.all_to_all(x, "shard", 0, 0)
+            return x
+        """
+        out = findings_for(src, "divergent-collective")
+        assert len(out) == 1
+        assert "'is_leader'" in out[0].message
+
+    def test_psum_launders_taint(self):
+        """The dist_train skip-step pattern: a predicate reduced with
+        psum is uniform across shards and must not fire."""
+        src = """
+        import jax.numpy as jnp
+        from jax import lax
+
+        def body(seeds, state):
+            me = lax.axis_index("shard")
+            nvalid = lax.psum(jnp.sum((seeds >= 0) + me * 0), "shard")
+            return lax.cond(nvalid > 0,
+                            lambda s: lax.pmean(s, "shard"),
+                            lambda s: s, state)
+        """
+        assert findings_for(src, "divergent-collective") == []
+
+    def test_divergent_branch_without_collective_clean(self):
+        src = """
+        from jax import lax
+
+        def body(x):
+            r = lax.axis_index("shard")
+            return lax.cond(r > 0, lambda v: v + 1, lambda v: v, x)
+        """
+        assert findings_for(src, "divergent-collective") == []
+
+
+# ---------------------------------------------------------------------------
+# GLT021 unknown-axis-name
+# ---------------------------------------------------------------------------
+
+class TestUnknownAxisName:
+    def test_stale_axis_string_fires(self):
+        src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def run(xs):
+            mesh = Mesh(np.array(jax.devices()), ("data",))
+
+            def body(x):
+                return jax.lax.psum(x, "shard")
+
+            return jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                                 out_specs=P("data"))(xs)
+        """
+        out = findings_for(src, "unknown-axis-name")
+        assert len(out) == 1
+        assert "'shard'" in out[0].message
+        assert "'data'" in out[0].message
+
+    def test_partition_spec_axis_checked(self):
+        src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def run(xs):
+            mesh = Mesh(np.array(jax.devices()), ("data",))
+
+            def body(x):
+                return jax.lax.psum(x, "data")
+
+            return jax.shard_map(body, mesh=mesh, in_specs=P("model"),
+                                 out_specs=P("data"))(xs)
+        """
+        out = findings_for(src, "unknown-axis-name")
+        assert len(out) == 1
+        assert "PartitionSpec" in out[0].message
+
+    def test_parametric_mesh_stays_quiet(self):
+        """multihost.global_mesh builds axes from a parameter — an open
+        mesh produces no findings whatever the body names."""
+        src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def global_mesh(axis_name="shard"):
+            return Mesh(np.array(jax.devices()), (axis_name,))
+
+        def run(xs):
+            mesh = global_mesh()
+
+            def body(x):
+                return jax.lax.psum(x, "anything")
+
+            return jax.shard_map(body, mesh=mesh, in_specs=P("shard"),
+                                 out_specs=P("shard"))(xs)
+        """
+        assert findings_for(src, "unknown-axis-name") == []
+
+    def test_matching_axes_clean(self):
+        src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def run(xs):
+            mesh = Mesh(np.array(jax.devices()), ("host", "chip"))
+
+            def body(x):
+                x = jax.lax.psum(x, "host")
+                return jax.lax.all_gather(x, "chip")
+
+            return jax.shard_map(body, mesh=mesh, in_specs=P("host"),
+                                 out_specs=P("host"))(xs)
+        """
+        assert findings_for(src, "unknown-axis-name") == []
+
+    def test_literal_forwarded_into_helper(self):
+        """One transitive step: a literal axis string passed into a
+        module function that forwards it to a collective."""
+        src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def reduce_all(x, axis_name):
+            return jax.lax.psum(x, axis_name)
+
+        def run(xs):
+            mesh = Mesh(np.array(jax.devices()), ("data",))
+
+            def body(x):
+                return reduce_all(x, "stale")
+
+            return jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                                 out_specs=P("data"))(xs)
+        """
+        out = findings_for(src, "unknown-axis-name")
+        assert len(out) == 1
+        assert "reduce_all" in out[0].message
+
+
+def test_device_program_rules_clean_on_ops_and_parallel():
+    """Real-tree smoke: the device-program passes (GLT017-021) verify
+    every committed kernel and shard_map body with zero findings —
+    GLT017 covers every candidate_{gather,sample}_params point."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "glt_tpu.analysis",
+         "glt_tpu/ops", "glt_tpu/parallel",
+         "--select=GLT017,GLT018,GLT019,GLT020,GLT021"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 error(s)" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
 # the gate itself
 # ---------------------------------------------------------------------------
 
@@ -1839,6 +2296,9 @@ def test_rule_registry_complete():
         "unbounded-queue-put", "dispatch-in-epoch-loop",
         "blocking-io-in-epoch-loop", "wall-clock-duration",
         "unbalanced-profiler-capture",
+        "vmem-budget-exceeded", "unbalanced-dma-ring",
+        "unaligned-tile-shape", "divergent-collective",
+        "unknown-axis-name",
     }
 
 
@@ -1854,7 +2314,8 @@ def test_cli_clean_on_glt_tpu():
 
 def test_cli_perf_guard():
     """The whole-project analysis (symbols + call graph + effects + all
-    rules) must stay under the CI job's 10 s budget."""
+    rules) must stay under the CI job's 10 s budget, and no single rule
+    pass may eat more than half of it."""
     import time
     t0 = time.monotonic()
     proc = subprocess.run(
@@ -1865,6 +2326,16 @@ def test_cli_perf_guard():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert elapsed < 10.0, f"gltlint took {elapsed:.1f}s (budget 10s)"
     assert "total" in proc.stderr       # --profile prints pass timings
+    # per-rule rows: "gltlint --profile:   pass <name>   <ms> ms"
+    passes = {}
+    for line in proc.stderr.splitlines():
+        parts = line.split()
+        if "pass" in parts and parts[-1] == "ms":
+            passes[parts[parts.index("pass") + 1]] = float(parts[-2])
+    assert "vmem-budget-exceeded" in passes     # new passes are timed
+    assert "divergent-collective" in passes
+    for name, ms in passes.items():
+        assert ms < 5000.0, f"pass {name} took {ms:.0f}ms (budget 5s)"
 
 
 def test_cli_flags_a_violation(tmp_path):
@@ -1890,8 +2361,26 @@ def test_cli_list_rules():
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0
     for code in ("GLT001", "GLT002", "GLT003", "GLT004", "GLT005",
-                 "GLT006", "GLT007", "GLT008", "GLT009"):
+                 "GLT006", "GLT007", "GLT008", "GLT009",
+                 "GLT017", "GLT018", "GLT019", "GLT020", "GLT021"):
         assert code in proc.stdout
+
+
+def test_cli_single_rule_mode():
+    """``--rule`` runs exactly one pass without the call-graph build —
+    the sub-second inner loop while burning down one finding class."""
+    proc = _run_cli("glt_tpu/ops", "--rule=GLT017")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 error(s)" in proc.stdout
+
+
+def test_cli_rule_rejects_lists_and_select():
+    proc = _run_cli("glt_tpu/ops", "--rule=GLT017,GLT018")
+    assert proc.returncode == 2
+    assert "exactly one rule" in proc.stderr
+    proc = _run_cli("glt_tpu/ops", "--rule=GLT017", "--select=GLT018")
+    assert proc.returncode == 2
+    assert "mutually exclusive" in proc.stderr
 
 
 # ---------------------------------------------------------------------------
