@@ -1090,6 +1090,104 @@ def main():
                             / (len(dblk) * Gd) * 1e3)
     _PARTIAL["dist_scanned_step_ms_tpu"] = round(dist_scanned_step_ms, 2)
 
+    # Hierarchical ICI/DCN routing A/B (ISSUE 17): the same dist train
+    # step with the topology seam pinned each way on a 2-D (host, chip)
+    # mesh, driven by a zipf-skewed frontier (the hub-heavy workload the
+    # per-host dedup exists for).  Needs >= 4 devices to form a real
+    # 2 x (C >= 2) grid — the 1-device tunnel skips it (keys pruned);
+    # CPU smoke runs with a forced 8-device host cover it.
+    # hier_dedup_factor is MEASURED on the frontier: flat request slots
+    # over host-unique DCN slots; dcn_bytes_* come from the static
+    # per-step byte model the glt.dist.collective_bytes counters use.
+    dist_flat_step_ms = dist_hier_step_ms = None
+    dcn_bytes_flat = dcn_bytes_hier = hier_dedup_factor = None
+    n_all = len(jax.devices())
+    if n_all >= 4:
+        _progress("dist hier routing A/B (2-D mesh, zipf frontier)")
+        from jax import lax
+        from jax.sharding import PartitionSpec as _P
+
+        from glt_tpu.parallel.dist_sampler import (
+            build_hier_routing,
+            resolve_mesh_axes,
+        )
+
+        Hh = 2
+        Cc = n_all // Hh
+        S2 = Hh * Cc
+        mesh2 = Mesh(np.array(jax.devices()[: S2]).reshape(Hh, Cc),
+                     ("host", "chip"))
+        axis2 = resolve_mesh_axes(mesh2)
+        # Per-shard batch smaller than the headline BATCH: the A/B reads
+        # a relative cost, and S2 devices each carry a full frontier.
+        HB = min(256, BATCH)
+        sg2 = put_sharded(shard_graph(topo, S2), mesh2, axis2)
+        sf2 = put_sharded(shard_feature(np.asarray(feat.hot_rows), S2),
+                          mesh2, axis2)
+        c2 = sg2.nodes_per_shard
+        lab_np = np.full((S2, c2), 0, np.int32)
+        flat_l = np.asarray(labels).reshape(-1)
+        for s2i in range(S2):
+            lo2, hi2 = s2i * c2, min((s2i + 1) * c2, flat_l.shape[0])
+            if lo2 < flat_l.shape[0]:
+                lab_np[s2i, : hi2 - lo2] = flat_l[lo2:hi2]
+        lab2 = jax.device_put(
+            jnp.asarray(lab_np),
+            jax.sharding.NamedSharding(mesh2,
+                                       jax.sharding.PartitionSpec(axis2)))
+        zr = np.random.default_rng(11)
+        zseeds = [jnp.asarray(np.minimum(
+            zr.zipf(1.5, size=(S2, HB)).astype(np.int64) - 1,
+            n - 1).astype(np.int32)) for _ in range(max(t_iters, 2))]
+
+        hier_ab_ms = {}
+        hier_ab_bytes = {}
+        for rt in ("flat", "hier"):
+            st2 = init_dist_state(model_f32, tx, sg2, sf2,
+                                  jax.random.PRNGKey(0), FANOUT, HB,
+                                  frontier_cap=fcap)
+            step2 = make_dist_train_step(model_f32, tx, sg2, sf2, lab2,
+                                         mesh2, FANOUT, HB,
+                                         frontier_cap=fcap, route=rt)
+            hier_ab_bytes[rt] = dict(step2.collective_bytes)
+            st2, l2, _ = step2(st2, zseeds[0],
+                               jax.random.fold_in(base, 400))
+            st2, l2, _ = step2(st2, zseeds[1 % len(zseeds)],
+                               jax.random.fold_in(base, 401))
+            sync(l2)
+            t0 = time.perf_counter()
+            for i in range(t_iters):
+                st2, l2, _ = step2(st2, zseeds[i % len(zseeds)],
+                                   jax.random.fold_in(base, 402 + i))
+            sync(l2)
+            hier_ab_ms[rt] = (time.perf_counter() - t0) / t_iters * 1e3
+        dist_flat_step_ms = hier_ab_ms["flat"]
+        dist_hier_step_ms = hier_ab_ms["hier"]
+        dcn_bytes_flat = hier_ab_bytes["flat"]["dcn"]
+        dcn_bytes_hier = hier_ab_bytes["hier"]["dcn"]
+
+        def _dedup_counts(i_blk):
+            hr = build_hier_routing(i_blk[0], sg2.nodes_per_shard, Hh,
+                                    Cc, "host", "chip")
+            flat_slots = lax.psum(
+                jnp.sum((hr.base.buckets >= 0).astype(jnp.int32)), axis2)
+            uniq_slots = lax.psum(
+                jnp.sum((hr.uniq >= 0).astype(jnp.int32)), axis2)
+            return jnp.stack([flat_slots, uniq_slots])
+
+        cfn = jax.jit(jax.shard_map(
+            _dedup_counts, mesh=mesh2, in_specs=(_P(axis2),),
+            out_specs=_P(), check_vma=False))
+        counts2 = np.asarray(cfn(zseeds[0]))
+        hier_dedup_factor = float(counts2[0]) / float(max(counts2[1], 1))
+        _PARTIAL.update({
+            "dist_flat_step_ms": round(dist_flat_step_ms, 2),
+            "dist_hier_step_ms": round(dist_hier_step_ms, 2),
+            "dcn_bytes_flat": dcn_bytes_flat,
+            "dcn_bytes_hier": dcn_bytes_hier,
+            "hier_dedup_factor": round(hier_dedup_factor, 3),
+        })
+
     # Analytic train FLOPs (fwd 2 matmuls/layer over the padded node cap;
     # bwd ~2x fwd) -> achieved TFLOP/s on the train-only step.
     dims = [dim] + [hidden] * (len(FANOUT) - 1) + [classes]
@@ -1242,6 +1340,13 @@ def main():
         "dist_collective_ms": round(dist_collective_ms, 2),
         "dist_routing_overhead": round(
             dist_sample_ms / max(full["sample_ms"], 1e-9), 2),
+        # Hierarchical ICI/DCN routing A/B (ISSUE 17) — pruned on
+        # meshes under 4 devices (the 1-device tunnel).
+        "dist_flat_step_ms": _round(dist_flat_step_ms, 2),
+        "dist_hier_step_ms": _round(dist_hier_step_ms, 2),
+        "dcn_bytes_flat": dcn_bytes_flat,
+        "dcn_bytes_hier": dcn_bytes_hier,
+        "hier_dedup_factor": _round(hier_dedup_factor, 3),
         # MEASURED epochs — the serial two-program reference and the
         # fused scanned route (examples/train_sage_products.py default),
         # not estimates.

@@ -85,6 +85,17 @@ EXPLICIT_DIRECTIONS: Dict[str, int] = {
     "overflow_rate": DOWN,
     "dist_routing_overhead": DOWN,
     "obs_noop_ns_per_call": DOWN,
+    # Hierarchical ICI/DCN routing A/B (ISSUE 17, parallel/dist_sampler
+    # HierarchicalRouting): both step timings track DOWN; the point of
+    # the dedup-then-exchange plan is the cross-host byte count, so
+    # dcn_bytes_hier tracks DOWN while the flat reference is a workload
+    # reading (NEUTRAL), and the measured zipf-frontier dedup factor
+    # (flat request slots / host-unique DCN slots) tracks UP.
+    "dist_flat_step_ms": DOWN,
+    "dist_hier_step_ms": DOWN,
+    "dcn_bytes_flat": NEUTRAL,
+    "dcn_bytes_hier": DOWN,
+    "hier_dedup_factor": UP,
     # Serving SLO metrics (benchmarks/bench_serving.py, docs/serving.md):
     # latency quantiles down-good, the coalescing win up-good.
     "serving_p50_ms": DOWN,
@@ -177,6 +188,11 @@ ASPIRATIONS: Dict[str, Tuple[str, float]] = {
     # at least 30% of memcpy on the sample stage's expected-bytes floor
     # — flat below that is stuck, exactly like the gather bar above.
     "sample_roofline_frac_pallas": (">=", 0.3),
+    # Hierarchical routing (ISSUE 17): the zipf-skewed bench frontier
+    # should collapse at least 1.5x of its flat request slots into
+    # host-unique DCN slots — flat below that means the per-host dedup
+    # is not earning its extra ICI hop.
+    "hier_dedup_factor": (">=", 1.5),
 }
 
 #: NEUTRAL-with-ceiling: metrics with no better/worse direction that

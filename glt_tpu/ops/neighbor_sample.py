@@ -99,6 +99,67 @@ def _draw_positions(deg: jnp.ndarray, fanout: int, key: jax.Array,
     return chosen, mask
 
 
+def _draw_positions_by_id(deg: jnp.ndarray, fanout: int, key: jax.Array,
+                          with_replacement: bool, seeds: jnp.ndarray):
+    """Layout-invariant draw: positions keyed per ``(key, seed id)``.
+
+    :func:`_draw_positions` keys randomness per (key, buffer slot), so
+    the same id draws *different* neighbors when it appears at a
+    different position (or more than once) in the request buffer.  The
+    hierarchical dedup-then-exchange transport
+    (:class:`glt_tpu.parallel.dist_sampler.HierarchicalRouting`) serves
+    each host-unique id once and broadcasts the response back to every
+    requesting slot — which is only bit-identical to the flat path if
+    a given id draws the same positions regardless of where (and how
+    often) it sits in the buffer.  Here each row derives its own key
+    with ``fold_in(key, id)``; everything else (Floyd's structure, the
+    duplicate test, the masks) mirrors :func:`_draw_positions` exactly.
+    """
+    b = deg.shape[0]
+    slot_ids = jnp.arange(fanout, dtype=jnp.int32)  # [k]
+    row_keys = jax.vmap(jax.random.fold_in, (None, 0))(
+        key, jnp.where(seeds >= 0, seeds, 0).astype(jnp.int32))
+
+    if with_replacement:
+        pos = jax.vmap(
+            lambda k, m: jax.random.randint(k, (fanout,), 0, m,
+                                            dtype=jnp.int32)
+        )(row_keys, jnp.maximum(deg, 1))
+        mask = (slot_ids[None, :] < jnp.where(deg > 0, fanout, 0)[:, None])
+        return pos, mask
+
+    chosen = jnp.full((b, fanout), -1, jnp.int32)
+    keys = jax.vmap(lambda k: jax.random.split(k, fanout))(row_keys)
+    for i in range(fanout):
+        j = deg - fanout + i                       # [B], >= 0 when deg > fanout
+        t = jax.vmap(
+            lambda k, m: jax.random.randint(k, (), 0, m, dtype=jnp.int32)
+        )(keys[:, i], jnp.maximum(j + 1, 1))
+        dup = jnp.any(chosen == t[:, None], axis=1)
+        floyd_pos = jnp.where(dup, j, t)
+        pos_i = jnp.where(deg > fanout, floyd_pos, i)
+        chosen = chosen.at[:, i].set(pos_i)
+    mask = slot_ids[None, :] < jnp.minimum(deg, fanout)[:, None]
+    return chosen, mask
+
+
+def draw_positions(deg: jnp.ndarray, fanout: int, key: jax.Array,
+                   with_replacement: bool, seeds: jnp.ndarray,
+                   key_by: str = "slot"):
+    """Draw dispatcher shared by the XLA and Pallas paths.
+
+    ``key_by='slot'`` is the historical per-(key, buffer slot) stream;
+    ``key_by='id'`` keys per (key, seed id) so draws are invariant to
+    request-buffer layout (required by hierarchical routing).
+    """
+    if key_by == "slot":
+        return _draw_positions(deg, fanout, key, with_replacement)
+    if key_by == "id":
+        return _draw_positions_by_id(deg, fanout, key, with_replacement,
+                                     seeds)
+    raise ValueError(f"key_by must be 'slot' or 'id', got {key_by!r}")
+
+
 def sample_neighbors(
     indptr: jnp.ndarray,
     indices: jnp.ndarray,
@@ -109,6 +170,7 @@ def sample_neighbors(
     with_replacement: bool = False,
     with_edge: bool = True,
     force: str = "auto",
+    key_by: str = "slot",
 ) -> NeighborOutput:
     """Sample up to ``fanout`` neighbors per seed from a CSR graph.
 
@@ -130,6 +192,10 @@ def sample_neighbors(
       force: neighbor-read kernel seam — 'auto' | 'pallas' | 'xla' |
         'interpret' (see module docstring).  ``GLT_SAMPLE_FORCE``
         overrides.
+      key_by: randomness keying — 'slot' (per buffer position, the
+        historical stream) or 'id' (per seed id, layout-invariant; used
+        by the hierarchical dedup-then-exchange transport so flat and
+        hier routing stay bit-identical).
 
     Returns:
       :class:`NeighborOutput` with static ``[B, fanout]`` arrays.  Rows with
@@ -152,9 +218,11 @@ def sample_neighbors(
             return _sp.sample_neighbors_pallas(
                 indptr, indices, seeds, fanout, key, edge_ids=edge_ids,
                 with_replacement=with_replacement, with_edge=with_edge,
-                params=params, interpret=(force == "interpret"))
+                params=params, interpret=(force == "interpret"),
+                key_by=key_by)
     start, deg = _row_offsets_and_degrees(indptr, seeds)
-    pos, mask = _draw_positions(deg, fanout, key, with_replacement)
+    pos, mask = draw_positions(deg, fanout, key, with_replacement, seeds,
+                               key_by=key_by)
     flat = start[:, None] + jnp.where(mask, pos, 0)
     nbrs = jnp.where(mask, indices[flat], PADDING_ID).astype(jnp.int32)
     if not with_edge:

@@ -47,8 +47,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..typing import PADDING_ID
 from . import tpu_limits
-from .neighbor_sample import (NeighborOutput, _draw_positions,
-                              _row_offsets_and_degrees)
+from .neighbor_sample import (NeighborOutput, _row_offsets_and_degrees,
+                              draw_positions)
 
 _LANE = tpu_limits.LANE
 
@@ -272,6 +272,7 @@ def sample_neighbors_pallas(
     with_edge: bool = True,
     params=None,
     interpret: bool = False,
+    key_by: str = "slot",
 ) -> NeighborOutput:
     """Degree-binned Pallas neighbor sampling — bit-identical to
     :func:`~glt_tpu.ops.neighbor_sample.sample_neighbors` (same draw,
@@ -298,7 +299,8 @@ def sample_neighbors_pallas(
     e = max(int(indices.shape[0]), wmax)
     pad_e = e - int(indices.shape[0])
     start, deg = _row_offsets_and_degrees(indptr, seeds)
-    pos, mask = _draw_positions(deg, fanout, key, with_replacement)
+    pos, mask = draw_positions(deg, fanout, key, with_replacement, seeds,
+                               key_by=key_by)
     pos0 = jnp.where(mask, pos, 0).astype(jnp.int32)
 
     binid, binid_s, estart_s, off_s, order, inv, bp = _plan_binned(

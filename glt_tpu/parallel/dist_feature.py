@@ -37,7 +37,9 @@ from jax import lax
 
 from ..ops.fused_frontier import fused_frontier as _fused_frontier
 from ..ops.unique import unique_first_occurrence
-from .dist_sampler import Routing, _use_fused, build_routing
+from .dist_sampler import (HierarchicalRouting, Routing, _topology_choice,
+                           _use_fused, build_hier_routing, build_routing,
+                           hier_requests, hier_response)
 
 
 def _dedup_scatter_back(urows: jnp.ndarray, inv: jnp.ndarray) -> jnp.ndarray:
@@ -82,6 +84,49 @@ def _exchange_ids(routing: Routing, num_shards: int, cap: int,
         tiled=False).reshape(num_shards * cap)
 
 
+def _resolve_plan(ids, nodes_per_shard, num_shards, axis_name, routing,
+                  route, mesh_shape, hier_load_factor):
+    """Shared plan prologue of every feature exchange: resolve the
+    routing plan — flat :class:`Routing` or 2-D-mesh
+    :class:`HierarchicalRouting`, building one when the caller didn't
+    pass a shared plan — and run the id-request leg(s).
+
+    Returns ``(routing, flat_plan, requests)``: ``requests`` is the id
+    vector this shard must serve (``[S*b]`` flat, ``[H*hier_cap]``
+    hier, where the hier DCN leg carries only the per-host-deduped
+    ids), and ``flat_plan`` drives the shared unscatter epilogue (the
+    hier response retraces its legs back into flat bucket order).
+    """
+    b = ids.shape[0]
+    if routing is None:
+        if _topology_choice(route, axis_name, mesh_shape) == "hier":
+            routing = build_hier_routing(
+                ids, nodes_per_shard, mesh_shape[0], mesh_shape[1],
+                axis_name[0], axis_name[1],
+                hier_load_factor=hier_load_factor, route=route)
+        else:
+            routing = build_routing(ids, nodes_per_shard, num_shards,
+                                    route=route)
+    if isinstance(routing, HierarchicalRouting):
+        return routing, routing.base, hier_requests(routing)
+    return routing, routing, _exchange_ids(routing, num_shards, b,
+                                           axis_name)
+
+
+def _return_payload(routing, payload, num_shards, b, axis_name):
+    """Response leg of every feature exchange: per-request-slot payload
+    back to the requesters, landing in flat bucket order
+    ``[num_shards * b, w]`` (the hier path retraces DCN then ICI in
+    reverse; dropped/padding slots come back as zero rows, exactly what
+    the flat path's masked serve produces)."""
+    w = payload.shape[-1]
+    if isinstance(routing, HierarchicalRouting):
+        return hier_response(routing, payload, 0)
+    return lax.all_to_all(
+        payload.reshape(num_shards, b, w), axis_name, 0, 0,
+        tiled=False).reshape(num_shards * b, w)
+
+
 def exchange_gather(
     ids: jnp.ndarray,
     rows: jnp.ndarray,
@@ -89,9 +134,11 @@ def exchange_gather(
     num_shards: int,
     axis_name: str,
     dedup: bool = False,
-    routing: Optional[Routing] = None,
+    routing=None,
     route: str = "auto",
     fused_frontier: str = "off",
+    mesh_shape: Optional[tuple] = None,
+    hier_load_factor: Optional[float] = None,
 ) -> jnp.ndarray:
     """Gather feature rows for global ``ids`` across shards.
 
@@ -103,12 +150,19 @@ def exchange_gather(
         hops, hub nodes) cross the ICI once instead of once per
         occurrence.  Output is bit-identical to ``dedup=False``.
       routing: pre-built plan for ``ids`` from
-        :func:`~glt_tpu.parallel.dist_sampler.build_routing` — reuse ONE
-        plan across the neighbor/feature/label exchanges of a frontier
-        instead of re-bucketing per exchange.  Ignored under ``dedup``
-        (the plan there is over the unique id list).
+        :func:`~glt_tpu.parallel.dist_sampler.build_routing` (or
+        :func:`~glt_tpu.parallel.dist_sampler.build_hier_routing` on a
+        2-D mesh) — reuse ONE plan across the neighbor/feature/label
+        exchanges of a frontier instead of re-bucketing per exchange.
+        Ignored under ``dedup`` (the plan there is over the unique id
+        list).
       fused_frontier: serving-side kernel seam (see
         :func:`_request_rows`); bit-identical either way.
+      mesh_shape: static ``(num_hosts, chips_per_host)`` when
+        ``axis_name`` is the 2-D mesh axis tuple — enables the
+        hierarchical dedup-then-exchange topology (``route='hier'``).
+      hier_load_factor: DCN buffer bound for the hier topology (see
+        :func:`~glt_tpu.parallel.dist_sampler.hier_request_cap`).
 
     Returns: ``[B, d]`` rows in input order.
     """
@@ -116,25 +170,23 @@ def exchange_gather(
         uniq, inv, _ = unique_first_occurrence(ids)
         urows = exchange_gather(uniq, rows, nodes_per_shard, num_shards,
                                 axis_name, route=route,
-                                fused_frontier=fused_frontier)
+                                fused_frontier=fused_frontier,
+                                mesh_shape=mesh_shape,
+                                hier_load_factor=hier_load_factor)
         return _dedup_scatter_back(urows, inv)
     b = ids.shape[0]
-    d = rows.shape[-1]
-    if routing is None:
-        routing = build_routing(ids, nodes_per_shard, num_shards,
-                                route=route)
-    requests = _exchange_ids(routing, num_shards, b, axis_name)
+    routing, flat_plan, requests = _resolve_plan(
+        ids, nodes_per_shard, num_shards, axis_name, routing, route,
+        mesh_shape, hier_load_factor)
 
     my_rank = lax.axis_index(axis_name)
     local = requests - my_rank * nodes_per_shard
     ok = (local >= 0) & (local < nodes_per_shard) & (requests >= 0)
     got = _request_rows(rows, local, ok, fused_frontier)
 
-    resp = lax.all_to_all(
-        got.reshape(num_shards, b, d), axis_name, 0, 0,
-        tiled=False).reshape(num_shards * b, d)
-    out = resp[jnp.clip(routing.slot, 0, num_shards * b - 1)]
-    return jnp.where(routing.valid[:, None], out, 0)
+    resp = _return_payload(routing, got, num_shards, b, axis_name)
+    out = resp[jnp.clip(flat_plan.slot, 0, num_shards * b - 1)]
+    return jnp.where(flat_plan.valid[:, None], out, 0)
 
 
 class TieredShardedFeature(NamedTuple):
@@ -228,8 +280,10 @@ def exchange_gather_hot(
     staged_rows: Optional[jnp.ndarray] = None,
     staged_slots: Optional[jnp.ndarray] = None,
     dedup: bool = False,
-    routing: Optional[Routing] = None,
+    routing=None,
     route: str = "auto",
+    mesh_shape: Optional[tuple] = None,
+    hier_load_factor: Optional[float] = None,
 ) -> jnp.ndarray:
     """Tiered gather; call inside ``shard_map``.
 
@@ -256,22 +310,22 @@ def exchange_gather_hot(
 
     ``dedup`` routes unique ids only (see :func:`exchange_gather`); the
     staged cold rows must then come from a :func:`route_cold_requests`
-    call made with the SAME ``dedup`` flag, or slot indices won't line
-    up with the deduped request layout.
+    call made with the SAME ``dedup`` flag — and, on a 2-D mesh, the
+    same topology (``route``/``mesh_shape``) — or slot indices won't
+    line up with the (possibly host-deduped) request layout.
     """
     if dedup:
         uniq, inv, _ = unique_first_occurrence(ids)
         urows = exchange_gather_hot(
             uniq, hot_rows, nodes_per_shard, hot_per_shard, num_shards,
             axis_name, staged_resp=staged_resp, staged_rows=staged_rows,
-            staged_slots=staged_slots, route=route)
+            staged_slots=staged_slots, route=route,
+            mesh_shape=mesh_shape, hier_load_factor=hier_load_factor)
         return _dedup_scatter_back(urows, inv)
     b = ids.shape[0]
-    d = hot_rows.shape[-1]
-    if routing is None:
-        routing = build_routing(ids, nodes_per_shard, num_shards,
-                                route=route)
-    requests = _exchange_ids(routing, num_shards, b, axis_name)
+    routing, flat_plan, requests = _resolve_plan(
+        ids, nodes_per_shard, num_shards, axis_name, routing, route,
+        mesh_shape, hier_load_factor)
 
     my_rank = lax.axis_index(axis_name)
     local = requests - my_rank * nodes_per_shard
@@ -281,7 +335,7 @@ def exchange_gather_hot(
         # Compact scatter: cold slots are disjoint from hot slots; -1
         # pad slots are dropped as out-of-bounds (no copy, no trash row).
         got = jnp.where(ok[:, None], got, 0)
-        idx = jnp.where(staged_slots >= 0, staged_slots, num_shards * b)
+        idx = jnp.where(staged_slots >= 0, staged_slots, got.shape[0])
         got = got.at[idx].set(staged_rows.astype(got.dtype), mode="drop")
     elif staged_resp is None:
         got = jnp.where(ok[:, None], got, 0)
@@ -290,11 +344,9 @@ def exchange_gather_hot(
         # (disjoint by construction; padding slots are zero either way).
         got = jnp.where(ok[:, None], got, staged_resp.astype(got.dtype))
 
-    resp = lax.all_to_all(
-        got.reshape(num_shards, b, d), axis_name, 0, 0,
-        tiled=False).reshape(num_shards * b, d)
-    out = resp[jnp.clip(routing.slot, 0, num_shards * b - 1)]
-    return jnp.where(routing.valid[:, None], out, 0)
+    resp = _return_payload(routing, got, num_shards, b, axis_name)
+    out = resp[jnp.clip(flat_plan.slot, 0, num_shards * b - 1)]
+    return jnp.where(flat_plan.valid[:, None], out, 0)
 
 
 def exchange_gather_xy(
@@ -308,10 +360,12 @@ def exchange_gather_xy(
     staged_rows: Optional[jnp.ndarray] = None,
     staged_slots: Optional[jnp.ndarray] = None,
     dedup: bool = False,
-    routing: Optional[Routing] = None,
+    routing=None,
     route: str = "auto",
     fused: Optional[bool] = None,
     fused_frontier: str = "off",
+    mesh_shape: Optional[tuple] = None,
+    hier_load_factor: Optional[float] = None,
 ):
     """Feature AND label gather for one frontier in a single exchange.
 
@@ -342,6 +396,10 @@ def exchange_gather_xy(
         target); other dtypes silently take the shared-routing split.
       fused_frontier: serving-side kernel seam for the feature-row fetch
         (see :func:`_request_rows`); bit-identical either way.
+      mesh_shape / hier_load_factor: 2-D mesh hierarchical-topology
+        knobs (see :func:`exchange_gather`).  The fused x+y payload
+        rides the hier legs as one block, so the feature+label lookup
+        stays a single round trip on both topologies.
 
     Returns:
       ``(x [B, d], y [B] int32)`` in input order (zeros at invalid
@@ -353,15 +411,15 @@ def exchange_gather_xy(
             uniq, rows, labels_col, nodes_per_shard, num_shards,
             axis_name, hot_per_shard=hot_per_shard,
             staged_rows=staged_rows, staged_slots=staged_slots,
-            route=route, fused=fused, fused_frontier=fused_frontier)
+            route=route, fused=fused, fused_frontier=fused_frontier,
+            mesh_shape=mesh_shape, hier_load_factor=hier_load_factor)
         return _dedup_scatter_back(ux, inv), _dedup_scatter_back_1d(uy, inv)
 
     b = ids.shape[0]
     d = rows.shape[-1]
-    if routing is None:
-        routing = build_routing(ids, nodes_per_shard, num_shards,
-                                route=route)
-    requests = _exchange_ids(routing, num_shards, b, axis_name)
+    routing, flat_plan, requests = _resolve_plan(
+        ids, nodes_per_shard, num_shards, axis_name, routing, route,
+        mesh_shape, hier_load_factor)
 
     my_rank = lax.axis_index(axis_name)
     local = requests - my_rank * nodes_per_shard
@@ -370,7 +428,7 @@ def exchange_gather_xy(
     oky = (local >= 0) & (local < nodes_per_shard) & (requests >= 0)
     gotx = _request_rows(rows, local, okx, fused_frontier)
     if staged_rows is not None:
-        idx = jnp.where(staged_slots >= 0, staged_slots, num_shards * b)
+        idx = jnp.where(staged_slots >= 0, staged_slots, gotx.shape[0])
         gotx = gotx.at[idx].set(staged_rows.astype(gotx.dtype),
                                 mode="drop")
     goty = jnp.take(labels_col.astype(jnp.int32),
@@ -379,23 +437,19 @@ def exchange_gather_xy(
 
     if _use_fused(fused) and rows.dtype == jnp.float32:
         ybits = lax.bitcast_convert_type(goty, jnp.float32)[:, None]
-        resp = lax.all_to_all(
-            jnp.concatenate([gotx, ybits], axis=-1)
-            .reshape(num_shards, b, d + 1), axis_name, 0, 0,
-            tiled=False).reshape(num_shards * b, d + 1)
+        resp = _return_payload(
+            routing, jnp.concatenate([gotx, ybits], axis=-1),
+            num_shards, b, axis_name)
         respx = resp[:, :d]
         respy = lax.bitcast_convert_type(resp[:, d], jnp.int32)
     else:
-        respx = lax.all_to_all(
-            gotx.reshape(num_shards, b, d), axis_name, 0, 0,
-            tiled=False).reshape(num_shards * b, d)
-        respy = lax.all_to_all(
-            goty.reshape(num_shards, b), axis_name, 0, 0,
-            tiled=False).reshape(num_shards * b)
+        respx = _return_payload(routing, gotx, num_shards, b, axis_name)
+        respy = _return_payload(routing, goty[:, None], num_shards, b,
+                                axis_name)[:, 0]
 
-    slot = jnp.clip(routing.slot, 0, num_shards * b - 1)
-    x = jnp.where(routing.valid[:, None], respx[slot], 0)
-    y = jnp.where(routing.valid, respy[slot], 0)
+    slot = jnp.clip(flat_plan.slot, 0, num_shards * b - 1)
+    x = jnp.where(flat_plan.valid[:, None], respx[slot], 0)
+    y = jnp.where(flat_plan.valid, respy[slot], 0)
     return x, y
 
 
@@ -429,28 +483,31 @@ def route_cold_requests(
     num_shards: int,
     axis_name: str,
     dedup: bool = False,
-    routing: Optional[Routing] = None,
+    routing=None,
     route: str = "auto",
+    mesh_shape: Optional[tuple] = None,
+    hier_load_factor: Optional[float] = None,
 ) -> jnp.ndarray:
     """Responder-side cold request slots; call inside ``shard_map``.
 
-    Runs the SAME deterministic bucketing + id all_to_all as
+    Runs the SAME deterministic bucketing + id exchange as
     :func:`exchange_gather_hot` and returns, for this shard, the local
     cold row index (``0..c-h``) of every incoming request slot, or -1
-    for hot/foreign/padding slots: ``[num_shards * b]``.  The host then
-    gathers exactly these rows from its local cold store — no host ever
-    touches another host's rows.  Pass the same ``dedup`` flag as the
-    paired :func:`exchange_gather_hot` call (the request layout is
-    computed over the deduped id list).
+    for hot/foreign/padding slots: ``[num_shards * b]`` on the flat
+    topology, ``[num_hosts * hier_cap]`` on the hierarchical one (the
+    request layout follows the topology).  The host then gathers
+    exactly these rows from its local cold store — no host ever touches
+    another host's rows.  Pass the same ``dedup`` flag — and, on a 2-D
+    mesh, the same ``route``/``mesh_shape``/``hier_load_factor`` — as
+    the paired :func:`exchange_gather_hot` call so both resolve the
+    identical request layout.
     """
     if dedup:
         ids = unique_first_occurrence(ids).uniques
         routing = None   # the shared plan is over the un-deduped list
-    b = ids.shape[0]
-    if routing is None:
-        routing = build_routing(ids, nodes_per_shard, num_shards,
-                                route=route)
-    requests = _exchange_ids(routing, num_shards, b, axis_name)
+    routing, _, requests = _resolve_plan(
+        ids, nodes_per_shard, num_shards, axis_name, routing, route,
+        mesh_shape, hier_load_factor)
     my_rank = lax.axis_index(axis_name)
     local = requests - my_rank * nodes_per_shard
     is_cold = (requests >= 0) & (local >= hot_per_shard) & (

@@ -31,6 +31,8 @@ from .dist_sampler import (
     autotune_routing,
     bounded_remote_cap,
     exchange_one_hop,
+    mesh_axis_sizes,
+    resolve_mesh_axes,
 )
 from .sharding import ShardedGraph, shard_graph
 
@@ -54,16 +56,23 @@ class DistHeteroNeighborSampler:
 
     def __init__(self, sharded: Dict[EdgeType, ShardedGraph], mesh: Mesh,
                  num_neighbors, input_type: NodeType,
-                 batch_size: int = 512, axis_name: str = "shard",
+                 batch_size: int = 512, axis_name: Optional[str] = None,
                  frontier_cap: Optional[int] = None,
                  seed: int = 0,
                  last_hop_dedup: bool = True,
                  exchange_load_factor: Optional[float] = None,
                  route: str = "auto",
-                 fused: Optional[bool] = None):
+                 fused: Optional[bool] = None,
+                 hier_load_factor: Optional[float] = None):
         self.sharded = sharded
         self.mesh = mesh
+        # None resolves to the mesh's own axes (1-D name or 2-D tuple);
+        # on a 2-D mesh the per-type hops ride the hierarchical
+        # dedup-then-exchange topology when the route seam picks 'hier'.
+        axis_name = resolve_mesh_axes(mesh, axis_name)
         self.axis_name = axis_name
+        self.mesh_shape = mesh_axis_sizes(mesh, axis_name)
+        self.hier_load_factor = hier_load_factor
         self.fused = fused
         # Capacity-bounded exchange, per edge type (homo parity — VERDICT
         # r4 #4; the reference's hetero engine issues worst-case per-hop
@@ -112,7 +121,8 @@ class DistHeteroNeighborSampler:
         if route == "auto":
             num_shards = next(iter(sharded.values())).num_shards
             widest = max(max(w.values()) for w in self._widths)
-            self.route = autotune_routing(widest, num_shards)
+            self.route = autotune_routing(widest, num_shards,
+                                          mesh_shape=self.mesh_shape)
 
         gspec = P(axis_name)
         arrays = {et: (g.indptr, g.indices, g.edge_ids)
@@ -139,7 +149,9 @@ class DistHeteroNeighborSampler:
         nbrs, eids, mask, dropped = exchange_one_hop(
             frontier, indptr, indices, edge_ids, g.nodes_per_shard,
             g.num_shards, fanout, key, self.axis_name,
-            remote_cap=remote_cap, route=self.route, fused=self.fused)
+            remote_cap=remote_cap, route=self.route, fused=self.fused,
+            mesh_shape=self.mesh_shape,
+            hier_load_factor=self.hier_load_factor)
         if self.exchange_load_factor is not None:
             self._trace_dropped.append(dropped)
         return NeighborOutput(nbrs=nbrs, eids=eids, mask=mask)

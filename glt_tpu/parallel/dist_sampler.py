@@ -66,6 +66,25 @@ def bounded_remote_cap(width: int, load_factor: float,
                max(1, -(-int(round(load_factor * width)) // num_shards)))
 
 
+def resolve_mesh_axes(mesh: Mesh, axis_name=None):
+    """Resolve a sampler/step ``axis_name`` argument against its mesh:
+    ``None`` derives the mesh's own axes (the axis name for a 1-D mesh,
+    the full name tuple for a 2-D ``(host, chip)`` mesh); an explicit
+    value passes through untouched (backward compat)."""
+    if axis_name is not None:
+        return axis_name
+    names = tuple(mesh.axis_names)
+    return names[0] if len(names) == 1 else names
+
+
+def mesh_axis_sizes(mesh: Mesh, axis_name):
+    """``(num_hosts, chips_per_host)`` for a 2-D axis tuple, else None
+    (1-D meshes have no topology choice to parameterize)."""
+    if isinstance(axis_name, str):
+        return None
+    return tuple(int(mesh.shape[a]) for a in axis_name)
+
+
 class Routing(NamedTuple):
     """Owner-bucketed routing plan for one frontier (see
     :func:`build_routing`): everything an exchange needs to scatter ids
@@ -237,7 +256,8 @@ def build_routing(ids: jnp.ndarray, nodes_per_shard: int, num_shards: int,
 
 
 def autotune_routing(b: int, num_shards: int, cap: Optional[int] = None,
-                     iters: int = 3, seed: int = 0) -> str:
+                     iters: int = 3, seed: int = 0,
+                     mesh_shape: Optional[tuple] = None) -> str:
     """Measure sort vs one-pass bucketing for this (B, S, cap) and
     memoize the winner for ``route='auto'``.
 
@@ -245,8 +265,21 @@ def autotune_routing(b: int, num_shards: int, cap: Optional[int] = None,
     trace.  Timing is fetch-synced (see bench.py: a host scalar fetch is
     the only sync that provably waits under the axon tunnel).  Off-TPU
     backends pin the shard-count heuristic without timing.
+
+    With ``mesh_shape=(H, C)`` (a 2-D mesh) the sweep also covers the
+    flat-vs-hier topology choice (memoized in the ``_TOPO_AUTO`` table
+    consumed by :func:`_topology_choice`): hier's extra cost is the
+    per-dest-host dedup (the legs are bandwidth, not compute), so on TPU
+    we time the vmapped ``unique_first_occurrence`` over the ``[H,
+    C*cap]`` slab against the flat bucketing it augments and keep hier
+    unless the dedup alone dwarfs the plan build; off-TPU the shape
+    heuristic (hier iff both axes > 1) is pinned without timing.  1-D
+    meshes never consult the table — :func:`_topology_choice` pins
+    'flat' before reaching it.
     """
     cap = b if cap is None else int(cap)
+    if mesh_shape is not None:
+        _autotune_topology(b, mesh_shape, cap, iters=iters, seed=seed)
     key = (int(b), int(num_shards), cap)
     if key in _ROUTE_AUTO:
         return _ROUTE_AUTO[key]
@@ -281,12 +314,275 @@ def autotune_routing(b: int, num_shards: int, cap: Optional[int] = None,
     return choice
 
 
+def _autotune_topology(b: int, mesh_shape, cap: int,
+                       iters: int = 3, seed: int = 0) -> str:
+    """Fill the flat-vs-hier decision table for one (H, C) grid."""
+    h, c = int(mesh_shape[0]), int(mesh_shape[1])
+    tkey = (h, c)
+    if tkey in _TOPO_AUTO:
+        return _TOPO_AUTO[tkey]
+    choice = "hier" if (h > 1 and c > 1) else "flat"
+    if choice == "hier" and jax.default_backend() == "tpu":
+        try:
+            rng = np.random.default_rng(seed)
+            num_shards = h * c
+            ids = jnp.asarray(rng.integers(
+                0, num_shards * max(b, 1), size=b).astype(np.int32))
+            owner = jnp.asarray(rng.integers(
+                0, num_shards, size=b).astype(np.int32))
+            slab = jnp.asarray(rng.integers(
+                -1, max(b, 2), size=(h, c * cap)).astype(np.int32))
+
+            def timed(f, *args):
+                g = jax.jit(f)
+                jax.block_until_ready(g(*args))    # compile + warm
+                t0 = time.perf_counter()
+                out = None
+                for _ in range(iters):
+                    out = g(*args)
+                jax.block_until_ready(out)         # fetch = true sync
+                return time.perf_counter() - t0
+
+            t_flat = timed(partial(_bucket_by_owner_sort,
+                                   num_shards=num_shards, cap=cap),
+                           ids, owner)
+            t_dedup = timed(jax.vmap(unique_first_occurrence), slab)
+            # The dedup is pure overhead vs flat; the DCN bytes it saves
+            # are shape-static (exchange_byte_model) and DCN is orders
+            # of magnitude slower than ICI, so keep hier unless the
+            # dedup dominates the whole plan build.
+            choice = "hier" if t_dedup < 8.0 * max(t_flat, 1e-9) \
+                else "flat"
+        except Exception:  # pragma: no cover - backend quirk
+            pass
+    _TOPO_AUTO[tkey] = choice
+    _M_ROUTE_AUTOTUNE.inc()
+    _metrics.gauge("glt.dist.route_hier_selected",
+                   "1 if the last topology autotune picked hierarchical",
+                   ).set(1.0 if choice == "hier" else 0.0)
+    return choice
+
+
 def _bucket_payload(routing: Routing, payload: jnp.ndarray,
                     num_shards: int, cap: int) -> jnp.ndarray:
     """Scatter a payload array into the same bucket slots as its ids."""
     buckets = jnp.full((num_shards * cap + 1,), PADDING_ID, jnp.int32)
     slot = jnp.where(routing.valid, routing.slot, num_shards * cap)
     return buckets.at[slot].set(payload)[:-1]
+
+
+# -- hierarchical (two-level ICI/DCN) routing ------------------------------
+#
+# On a 2-D (host, chip) mesh (multihost.global_mesh_2d) the flat plan
+# wastes the slow fabric: a frontier id that every chip of one host wants
+# crosses DCN once PER CHIP.  The hierarchical plan dedups within the
+# host first:
+#
+#   per-chip owner bucketing            [S*cap] viewed [H, C, cap]
+#     -> intra-host all_to_all (ICI, chip axis, split/concat dim 1)
+#   per-dest-host slab                  [H, C*cap] on the owner-chip column
+#     -> vmapped unique_first_occurrence per dest-host row
+#   host-unique ids + inverse           uniq [H, hier_cap], inv [H, C*cap]
+#     -> cross-host all_to_all (DCN, host axis) of ONLY uniq
+#   owner serves each unique id once    [H*hier_cap] -> payload
+#     -> DCN back, expand via inv (take_along_axis; inv never crossed DCN)
+#     -> ICI back (chip axis), landing in the flat bucket order
+#   flat unscatter                      resp[base.slot] masked by base.valid
+#
+# The response retraces the request legs in reverse, so the final scatter
+# is the unmodified flat epilogue.  Bit-identity with the flat path holds
+# because on 2-D meshes draws are keyed per (key, id) — layout-invariant
+# — so serving a deduped id once and broadcasting the answer equals
+# serving every duplicate slot (ops/neighbor_sample.draw_positions).
+
+#: Decision table for the 2-D topology choice: (H, C, b, cap) -> 'flat' |
+#: 'hier', filled by autotune_routing when given a mesh_shape.
+_TOPO_AUTO: dict = {}
+
+
+class HierGeom(NamedTuple):
+    """Static geometry of a hierarchical plan (never crosses a jit
+    boundary — built and consumed inside one shard_map body)."""
+    num_hosts: int
+    chips_per_host: int
+    host_axis: str
+    chip_axis: str
+    cap: int        # per-owner bucket capacity of the flat base plan
+    hier_cap: int   # per-dest-host unique-request capacity (DCN leg width)
+
+
+class HierarchicalRouting(NamedTuple):
+    """Two-level routing plan for one frontier on a 2-D mesh (see
+    :func:`build_hier_routing`).  Wraps the flat :class:`Routing` (whose
+    ``slot``/``valid`` still drive the final unscatter) plus the per-host
+    dedup state the DCN legs ride on.  Like :class:`Routing`: build ONCE
+    per hop frontier, thread through every exchange over that frontier.
+    """
+    base: Routing
+    uniq: jnp.ndarray          # [H, hier_cap] host-unique ids, -1 padded
+    inv: jnp.ndarray           # [H, C*cap] index into uniq row, -1 = pad/drop
+    hier_dropped: jnp.ndarray  # [] int32: unique ids beyond hier_cap
+    geom: HierGeom
+
+
+def hier_request_cap(cap: int, chips_per_host: int, nodes_per_shard: int,
+                     hier_load_factor: Optional[float] = None) -> int:
+    """DCN-leg width per dest host: how many host-unique ids one device
+    forwards to each remote host.
+
+    The lossless bound is ``min(C*cap, nodes_per_shard)`` — a dest-host
+    slab has ``C*cap`` slots, and its uniques are all owned by ONE shard
+    so there can never be more than ``nodes_per_shard`` of them.  An
+    explicit ``hier_load_factor`` (α) bounds the buffer at
+    ``ceil(α * C * cap)`` like ``exchange_load_factor`` does for the flat
+    buckets: overflow is dropped (masked padding, counted), and the DCN
+    bytes shrink by ~1/α.
+    """
+    lossless = min(int(chips_per_host) * int(cap),
+                   max(1, int(nodes_per_shard)))
+    if hier_load_factor is None:
+        return lossless
+    bounded = max(1, int(np.ceil(float(hier_load_factor)
+                                 * chips_per_host * cap)))
+    return min(lossless, bounded)
+
+
+def _topology_choice(route: str, axis_name,
+                     mesh_shape: Optional[tuple] = None) -> str:
+    """Resolve the routing topology ('flat' | 'hier') at trace time.
+
+    Priority: ``GLT_ROUTE_FORCE`` env ('flat'/'hier') > explicit
+    ``route`` argument > 1-D meshes pin 'flat' > autotuned decision table
+    > default ('hier' on a mesh with both axes > 1, else 'flat').  The
+    same env var keeps carrying the bucketing values ('sort'/'onepass');
+    the two sub-seams are orthogonal and each ignores the other's tokens.
+    """
+    env = os.environ.get("GLT_ROUTE_FORCE")
+    forced = env if env in ("flat", "hier") else (
+        route if route in ("flat", "hier") else None)
+    if isinstance(axis_name, str) or len(tuple(axis_name)) < 2:
+        return "flat"          # 1-D meshes pin flat, even when forced
+    if forced is not None:
+        return forced
+    if mesh_shape is None:
+        return "flat"
+    h, c = int(mesh_shape[0]), int(mesh_shape[1])
+    if h < 2 or c < 2:
+        return "flat"          # degenerate grid: nothing to dedup over
+    hit = _TOPO_AUTO.get((h, c))
+    return hit if hit is not None else "hier"
+
+
+def build_hier_routing(
+    ids: jnp.ndarray,
+    nodes_per_shard: int,
+    num_hosts: int,
+    chips_per_host: int,
+    host_axis: str,
+    chip_axis: str,
+    cap: Optional[int] = None,
+    hier_load_factor: Optional[float] = None,
+    route: str = "auto",
+    base: Optional[Routing] = None,
+) -> HierarchicalRouting:
+    """Build the two-level routing plan for a frontier; call inside
+    ``shard_map`` over the 2-D mesh, ONCE per hop frontier.
+
+    Runs the ICI request leg and the per-dest-host dedup eagerly (they
+    are part of the plan — every exchange over this frontier reuses the
+    same ``uniq``/``inv``); the DCN legs run per exchange.  ``inv`` stays
+    device-local: only the host-unique ids ever cross DCN.
+
+    Args:
+      ids: ``[B]`` global node ids, -1 padded.
+      cap: per-owner bucket capacity; ``None`` -> ``B`` (overflow-free).
+      hier_load_factor: DCN buffer bound (see :func:`hier_request_cap`).
+      base: pre-built flat :class:`Routing` over ``ids`` with this
+        ``cap``, if the caller already has one.
+    """
+    b = ids.shape[0]
+    cap = b if cap is None else int(cap)
+    h, c = int(num_hosts), int(chips_per_host)
+    num_shards = h * c
+    if base is None:
+        owner = jnp.where(ids >= 0, ids // nodes_per_shard, -1)
+        base = _bucket_by_owner(ids, owner, num_shards, cap=cap,
+                                route=route)
+    # ICI leg: land every local chip's bucket for owner (oh, my_chip) on
+    # this device — slab[oh, q*cap + j] = chip q's j-th request for that
+    # owner.
+    slab = lax.all_to_all(base.buckets.reshape(h, c, cap), chip_axis,
+                          1, 1, tiled=False).reshape(h, c * cap)
+    u = jax.vmap(unique_first_occurrence)(slab)
+    hc = hier_request_cap(cap, c, nodes_per_shard, hier_load_factor)
+    uniq = u.uniques[:, :hc]
+    inv = jnp.where((u.inverse >= 0) & (u.inverse < hc), u.inverse, -1)
+    hier_dropped = jnp.sum(jnp.maximum(u.count - hc, 0)).astype(jnp.int32)
+    return HierarchicalRouting(
+        base=base, uniq=uniq, inv=inv, hier_dropped=hier_dropped,
+        geom=HierGeom(num_hosts=h, chips_per_host=c, host_axis=host_axis,
+                      chip_axis=chip_axis, cap=cap, hier_cap=hc))
+
+
+def hier_requests(hr: HierarchicalRouting) -> jnp.ndarray:
+    """DCN request leg: ``[H * hier_cap]`` host-unique ids addressed to
+    this device (row ``qh`` came from host ``qh``'s same-chip peer)."""
+    g = hr.geom
+    return lax.all_to_all(hr.uniq, g.host_axis, 0, 0,
+                          tiled=False).reshape(g.num_hosts * g.hier_cap)
+
+
+def hier_response(hr: HierarchicalRouting, payload: jnp.ndarray,
+                  fill) -> jnp.ndarray:
+    """Retrace the request legs in reverse: per-unique-request payload
+    ``[H * hier_cap, W]`` -> ``[S * cap, W]`` in flat bucket order.
+
+    DCN back (host axis), expand each dest-host row through ``inv``
+    (duplicates get copies of the one served answer; dropped/padding
+    slots get ``fill``), then ICI back (chip axis) to the requesting
+    chip.  The result unscatters with the unmodified flat epilogue
+    ``payload[base.slot]`` under ``base.valid``.
+    """
+    g = hr.geom
+    w = payload.shape[-1]
+    resp = lax.all_to_all(payload.reshape(g.num_hosts, g.hier_cap, w),
+                          g.host_axis, 0, 0, tiled=False)
+    safe = jnp.clip(hr.inv, 0, g.hier_cap - 1)
+    full = jnp.take_along_axis(resp, safe[..., None], axis=1)
+    full = jnp.where((hr.inv >= 0)[..., None], full, fill)
+    back = lax.all_to_all(
+        full.reshape(g.num_hosts, g.chips_per_host, g.cap, w),
+        g.chip_axis, 1, 1, tiled=False)
+    return back.reshape(g.num_hosts * g.chips_per_host * g.cap, w)
+
+
+def exchange_byte_model(topology: str, num_hosts: int, chips_per_host: int,
+                        cap: int, payload_elems: int,
+                        hier_cap: Optional[int] = None,
+                        elem_bytes: int = 4):
+    """Per-device ``(ici_bytes, dcn_bytes)`` for one request+response
+    round trip, from static plan shapes (what the
+    ``glt.dist.collective_bytes{axis=}`` counters accumulate).
+
+    Flat on ``[H, C]``: each device sends ``cap`` ids (+ ``payload_elems``
+    response elems per slot) to all ``S-1`` peers — ``C-1`` of them over
+    ICI, ``(H-1)*C`` over DCN.  Hier: the ICI legs move the full
+    ``[H, C, cap]`` bucket block minus the self column; only
+    ``(H-1) * hier_cap`` slots cross DCN.
+    """
+    h, c = int(num_hosts), int(chips_per_host)
+    per_slot = (1 + int(payload_elems)) * int(elem_bytes)
+    if topology == "flat":
+        ici = (c - 1) * cap * per_slot
+        dcn = (h - 1) * c * cap * per_slot
+    elif topology == "hier":
+        hc = c * cap if hier_cap is None else int(hier_cap)
+        ici = (c - 1) * h * cap * per_slot
+        dcn = (h - 1) * hc * per_slot
+    else:
+        raise ValueError(f"topology must be 'flat' or 'hier', "
+                         f"got {topology!r}")
+    return int(ici), int(dcn)
 
 
 def build_sorted_edge_view(indptr: jnp.ndarray, indices: jnp.ndarray):
@@ -398,7 +694,9 @@ def exchange_one_hop(
     remote_cap: Optional[int] = None,
     route: str = "auto",
     fused: Optional[bool] = None,
-    routing: Optional[Routing] = None,
+    routing=None,
+    mesh_shape: Optional[tuple] = None,
+    hier_load_factor: Optional[float] = None,
 ):
     """One distributed sampling hop; call inside ``shard_map``.
 
@@ -408,6 +706,10 @@ def exchange_one_hop(
         (:class:`~glt_tpu.parallel.sharding.ShardedGraph` fields with the
         leading shard axis already consumed by shard_map).
       key: per-shard PRNG key (fold in the axis index for decorrelation).
+      axis_name: the mesh axis (str) or axis tuple — a 2-D
+        ``("host", "chip")`` mesh passes the tuple; the flat topology
+        then addresses the combined axis (host-major, identical to the
+        1-D flat order) and the hier topology splits the legs per axis.
       remote_cap: capacity-bounded exchange (VERDICT r3 #3).  ``None``
         reproduces the reference-exact worst-case buffers (every shard
         reserves the full frontier width ``B`` for every destination, so
@@ -421,27 +723,52 @@ def exchange_one_hop(
         (S*remote_cap)``.  Ids past an owner's cap are dropped (masked
         padding, never garbage) and counted.
       route / fused: routing-path and collective-fusion seams (see
-        :func:`_route_choice` / :func:`_use_fused`).
-      routing: pre-built full-width :class:`Routing` for ``seeds`` (from
-        :func:`build_routing`) — only honored when ``remote_cap`` is
-        None (the capped path buckets the remote-masked subset, a
-        different plan).
+        :func:`_route_choice` / :func:`_use_fused`); ``route`` also
+        carries the topology tokens 'flat'/'hier' (see
+        :func:`_topology_choice`).
+      routing: pre-built :class:`Routing` (flat) or
+        :class:`HierarchicalRouting` for ``seeds`` — only honored when
+        ``remote_cap`` is None (the capped path buckets the
+        remote-masked subset, a different plan).  A hierarchical plan
+        forces the hier transport regardless of ``route``.
+      mesh_shape: ``(num_hosts, chips_per_host)`` of the 2-D mesh —
+        required for the hier topology when ``routing`` is not prebuilt.
+      hier_load_factor: DCN-leg buffer bound (see
+        :func:`hier_request_cap`); None = lossless.
 
     Returns:
       ``(nbrs, eids, mask, dropped)``; first three ``[B, fanout]`` in seed
       order, ``dropped`` a scalar int32 (always 0 when ``remote_cap`` is
-      None).
+      None and the hier DCN buffer is lossless).
     """
     b = seeds.shape[0]
     my_rank = lax.axis_index(axis_name)
     owner = jnp.where(seeds >= 0, seeds // nodes_per_shard, -1)
+    # `hier` reads ONLY the incoming argument and the static topology
+    # seam — never the rebuilt plan below — so the branch predicate is
+    # provably uniform across shards (GLT020's taint chain stops at the
+    # parameter).  The plan gets its own name for the same reason.
+    hier = isinstance(routing, HierarchicalRouting) or (
+        routing is None
+        and _topology_choice(route, axis_name, mesh_shape) == "hier")
+    plan = routing
+    # 2-D meshes key draws per (key, id) so the flat and hier transports
+    # are bit-identical (dedup serves each id once); 1-D meshes keep the
+    # historical per-slot stream.
+    key_by = "slot" if isinstance(axis_name, str) else "id"
 
     if remote_cap is None:
-        if routing is None:
-            routing = _bucket_by_owner(seeds, owner, num_shards, cap=b,
-                                       route=route)
         cap = b
         local_nbrs = local_eids = None
+        if hier and not isinstance(plan, HierarchicalRouting):
+            plan = build_hier_routing(
+                seeds, nodes_per_shard, mesh_shape[0], mesh_shape[1],
+                axis_name[0], axis_name[1], cap=b,
+                hier_load_factor=hier_load_factor, route=route,
+                base=plan)
+        elif plan is None:
+            plan = _bucket_by_owner(seeds, owner, num_shards, cap=b,
+                                    route=route)
     else:
         cap = int(remote_cap)
         # Local split: owner == my shard -> direct sample, no collective.
@@ -449,26 +776,48 @@ def exchange_one_hop(
         local_ids = jnp.where(is_local, seeds - my_rank * nodes_per_shard,
                               -1)
         lout = sample_neighbors(indptr, indices, local_ids, fanout, key,
-                                edge_ids=edge_ids)
+                                edge_ids=edge_ids, key_by=key_by)
         local_nbrs, local_eids = lout.nbrs, lout.eids
         remote_ids = jnp.where(is_local, PADDING_ID, seeds)
-        routing = _bucket_by_owner(remote_ids, owner, num_shards, cap=cap,
-                                   route=route)
+        if hier:
+            plan = build_hier_routing(
+                remote_ids, nodes_per_shard, mesh_shape[0], mesh_shape[1],
+                axis_name[0], axis_name[1], cap=cap,
+                hier_load_factor=hier_load_factor, route=route)
+        else:
+            plan = _bucket_by_owner(remote_ids, owner, num_shards,
+                                    cap=cap, route=route)
 
-    # Request exchange: row q of `requests` = ids wanted by shard q from us.
-    requests = lax.all_to_all(
-        routing.buckets.reshape(num_shards, cap), axis_name, 0, 0,
-        tiled=False).reshape(num_shards * cap)
+    flat_plan = plan.base if hier else plan
+
+    # Request exchange: the ids this shard must serve.  Flat: row q =
+    # ids wanted by shard q from us.  Hier: row qh = host qh's unique
+    # wants from us (DCN leg; the ICI leg already ran in the plan build).
+    if hier:
+        requests = hier_requests(plan)
+    else:
+        requests = lax.all_to_all(
+            plan.buckets.reshape(num_shards, cap), axis_name, 0, 0,
+            tiled=False).reshape(num_shards * cap)
 
     # Sample requested ids from the local CSR block (global -> local row).
     local = jnp.where(requests >= 0,
                       requests - my_rank * nodes_per_shard, -1)
     local = jnp.where((local >= 0) & (local < nodes_per_shard), local, -1)
     out = sample_neighbors(indptr, indices, local, fanout,
-                           jax.random.fold_in(key, 1), edge_ids=edge_ids)
+                           jax.random.fold_in(key, 1), edge_ids=edge_ids,
+                           key_by=key_by)
 
     # Response exchange + unscatter (the stitch, stitch_sample_results.cu:57).
-    if _use_fused(fused):
+    if hier:
+        # The hier transport always packs neighbors + edge ids into one
+        # payload (its legs are shared infrastructure); `fused` only
+        # selects the flat path's collective shape.
+        resp = hier_response(
+            plan, jnp.concatenate([out.nbrs, out.eids], axis=-1),
+            fill=PADDING_ID)
+        resp_nbrs, resp_eids = resp[:, :fanout], resp[:, fanout:]
+    elif _use_fused(fused):
         # Neighbors and edge ids ride ONE [S, cap, 2*fanout] collective
         # (half the per-hop launches); the halves split back bit-exact.
         resp = lax.all_to_all(
@@ -484,15 +833,17 @@ def exchange_one_hop(
             out.eids.reshape(num_shards, cap, fanout), axis_name, 0, 0,
             tiled=False).reshape(num_shards * cap, fanout)
 
-    nbrs = jnp.where(routing.valid[:, None],
-                     resp_nbrs[routing.slot], PADDING_ID)
-    eids = jnp.where(routing.valid[:, None],
-                     resp_eids[routing.slot], PADDING_ID)
+    nbrs = jnp.where(flat_plan.valid[:, None],
+                     resp_nbrs[flat_plan.slot], PADDING_ID)
+    eids = jnp.where(flat_plan.valid[:, None],
+                     resp_eids[flat_plan.slot], PADDING_ID)
     if local_nbrs is not None:
         sel = is_local[:, None]
         nbrs = jnp.where(sel, local_nbrs, nbrs)
         eids = jnp.where(sel, local_eids, eids)
-    return nbrs, eids, nbrs >= 0, routing.dropped
+    dropped = (flat_plan.dropped + plan.hier_dropped if hier
+               else plan.dropped)
+    return nbrs, eids, nbrs >= 0, dropped
 
 
 def exchange_one_hop_ring(
@@ -509,6 +860,8 @@ def exchange_one_hop_ring(
     route: str = "auto",
     fused: Optional[bool] = None,
     routing: Optional[Routing] = None,
+    mesh_shape: Optional[tuple] = None,
+    hier_load_factor: Optional[float] = None,
 ):
     """Ring-pipelined variant of :func:`exchange_one_hop`.
 
@@ -522,17 +875,24 @@ def exchange_one_hop_ring(
     as in :func:`exchange_one_hop` (local seeds never enter the ring).
     With ``fused`` the neighbor/edge-id answer buffers travel as one
     packed block, cutting the per-step ppermute launches from 3 to 2.
+    The ring is a flat topology by construction — ``mesh_shape`` /
+    ``hier_load_factor`` are accepted for signature parity with
+    :func:`exchange_one_hop` and ignored (on a 2-D mesh the ring rotates
+    the combined axis; draws keep the 2-D per-id keying so it stays
+    comparable with the all-to-all paths).
     """
+    del mesh_shape, hier_load_factor  # flat-only transport
     b = seeds.shape[0]
     my = lax.axis_index(axis_name)
     owner = jnp.where(seeds >= 0, seeds // nodes_per_shard, -1)
+    key_by = "slot" if isinstance(axis_name, str) else "id"
 
     def local_sample(ids, k):
         local = jnp.where(ids >= 0, ids - my * nodes_per_shard, -1)
         local = jnp.where((local >= 0) & (local < nodes_per_shard), local, -1)
         return sample_neighbors(indptr, indices, local, fanout,
                                 jax.random.fold_in(key, k),
-                                edge_ids=edge_ids)
+                                edge_ids=edge_ids, key_by=key_by)
 
     if remote_cap is None:
         cap = b
@@ -626,6 +986,8 @@ def dist_sample_multi_hop(
     exchange_load_factor: Optional[float] = None,
     route: str = "auto",
     fused: Optional[bool] = None,
+    mesh_shape: Optional[tuple] = None,
+    hier_load_factor: Optional[float] = None,
 ) -> SamplerOutput:
     """Per-shard multi-hop sampling body; call inside ``shard_map``.
 
@@ -651,11 +1013,15 @@ def dist_sample_multi_hop(
     ``route`` / ``fused`` select the bucketing implementation and the
     packed response collective (see :func:`_route_choice` /
     :func:`_use_fused`); on the exact (uncapped) path each hop's routing
-    plan is built ONCE via :func:`build_routing` and threaded into the
-    exchange.
+    plan is built ONCE via :func:`build_routing` (or
+    :func:`build_hier_routing` when the topology resolves hierarchical
+    on a 2-D mesh — ``mesh_shape``/``hier_load_factor`` parameterize the
+    two-level plan) and threaded into the exchange.
     """
     exchange = (exchange_one_hop if collective == "all_to_all"
                 else exchange_one_hop_ring)
+    topo = ("flat" if collective != "all_to_all"
+            else _topology_choice(route, axis_name, mesh_shape))
     fanouts = list(num_neighbors)
     widths = hop_widths(seeds.shape[0], fanouts, frontier_cap)
     cap = max_sampled_nodes(seeds.shape[0], fanouts, frontier_cap)
@@ -696,13 +1062,21 @@ def dist_sample_multi_hop(
         # One routing plan per hop frontier (exact path); the capped
         # path buckets only the remote-masked subset inside the
         # exchange, a different plan per construction.
-        hop_routing = (build_routing(frontier, nodes_per_shard,
-                                     num_shards, route=route)
-                       if remote_cap is None else None)
+        if remote_cap is not None:
+            hop_routing = None
+        elif topo == "hier":
+            hop_routing = build_hier_routing(
+                frontier, nodes_per_shard, mesh_shape[0], mesh_shape[1],
+                axis_name[0], axis_name[1],
+                hier_load_factor=hier_load_factor, route=route)
+        else:
+            hop_routing = build_routing(frontier, nodes_per_shard,
+                                        num_shards, route=route)
         nbrs, eids, mask, dropped = exchange(
             frontier, indptr, indices, edge_ids, nodes_per_shard,
             num_shards, f, keys[i], axis_name, remote_cap=remote_cap,
-            route=route, fused=fused, routing=hop_routing)
+            route=route, fused=fused, routing=hop_routing,
+            mesh_shape=mesh_shape, hier_load_factor=hier_load_factor)
         dropped_total = dropped_total + dropped
 
         src_local = frontier_start + jnp.arange(w, dtype=jnp.int32)
@@ -781,7 +1155,9 @@ def dist_sample_multi_hop(
         edge_mask=jnp.concatenate(emasks),
         num_sampled_nodes=num_sampled_nodes,
         num_sampled_edges=jnp.stack(edges_per_hop),
-        metadata=(None if exchange_load_factor is None
+        metadata=(None
+                  if exchange_load_factor is None
+                  and hier_load_factor is None
                   else {"exchange_dropped": dropped_total}),
     )
 
@@ -875,7 +1251,8 @@ class DistNeighborSampler:
     batch is its own ego-subgraph, ready for data-parallel training.
     """
 
-    def __init__(self, sharded_graph, mesh: Mesh, axis_name: str = "shard",
+    def __init__(self, sharded_graph, mesh: Mesh,
+                 axis_name: Optional[str] = None,
                  num_neighbors: Sequence[int] = (15, 10, 5),
                  batch_size: int = 512,
                  frontier_cap: Optional[int] = None,
@@ -885,17 +1262,21 @@ class DistNeighborSampler:
                  last_hop_dedup: bool = True,
                  exchange_load_factor: Optional[float] = None,
                  route: str = "auto",
-                 fused: Optional[bool] = None):
+                 fused: Optional[bool] = None,
+                 hier_load_factor: Optional[float] = None):
         self.collective = collective
         self.valid_per_shard = valid_per_shard
         self.last_hop_dedup = bool(last_hop_dedup)
         self.exchange_load_factor = exchange_load_factor
         self.fused = fused
+        self.hier_load_factor = hier_load_factor
         self._edges_fns = {}
         self._subgraph_fns = {}
         self.g = sharded_graph
         self.mesh = mesh
-        self.axis_name = axis_name
+        self.axis_name = resolve_mesh_axes(mesh, axis_name)
+        axis_name = self.axis_name
+        self.mesh_shape = mesh_axis_sizes(mesh, self.axis_name)
         self.num_neighbors = list(num_neighbors)
         self.batch_size = int(batch_size)
         self.frontier_cap = frontier_cap
@@ -906,11 +1287,15 @@ class DistNeighborSampler:
         # Routing A/B seam: 'auto' autotunes sort vs one-pass at the
         # dominant (widest-frontier) shape on TPU; elsewhere the
         # shard-count heuristic picks (env GLT_ROUTE_FORCE still wins at
-        # trace time — see _route_choice).
+        # trace time — see _route_choice).  On a 2-D mesh the same sweep
+        # also fills the flat-vs-hier topology table; the topology token
+        # itself resolves at trace time (_topology_choice) so the
+        # resolved bucketing choice stored here never erases it.
         self.route = route
         if route == "auto":
             self.route = autotune_routing(max(self._widths),
-                                          self.g.num_shards)
+                                          self.g.num_shards,
+                                          mesh_shape=self.mesh_shape)
         self.node_capacity = max_sampled_nodes(self.batch_size,
                                                self.num_neighbors,
                                                frontier_cap)
@@ -941,7 +1326,9 @@ class DistNeighborSampler:
             self.axis_name, self.frontier_cap, self.collective,
             last_hop_dedup=self.last_hop_dedup,
             exchange_load_factor=self.exchange_load_factor,
-            route=self.route, fused=self.fused)
+            route=self.route, fused=self.fused,
+            mesh_shape=self.mesh_shape,
+            hier_load_factor=self.hier_load_factor)
         # Re-add the shard axis for shard_map's out_specs.
         return jax.tree.map(lambda x: x[None], out)
 

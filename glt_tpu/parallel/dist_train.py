@@ -35,24 +35,89 @@ from .dist_feature import (
     exchange_gather_xy,
     route_cold_requests,
 )
-from .dist_sampler import DistNeighborSampler, dist_sample_multi_hop
+from ..obs import metrics as _metrics
+from .dist_sampler import (DistNeighborSampler, _topology_choice,
+                           dist_sample_multi_hop, exchange_byte_model,
+                           hier_request_cap, mesh_axis_sizes,
+                           resolve_mesh_axes)
 from .sharding import ShardedFeature, ShardedGraph
+
+
+def dist_step_byte_model(nodes_per_shard, num_shards, num_neighbors,
+                         batch_size, frontier_cap, feature_dim, axis_name,
+                         mesh_shape, route="auto", hier_load_factor=None,
+                         elem_bytes=4):
+    """Static per-device collective bytes for ONE dist train step.
+
+    Sums :func:`~glt_tpu.parallel.dist_sampler.exchange_byte_model` over
+    the step's exchanges — one per sampling hop (id request + fanout
+    neighbor/edge-id payload) plus the fused feature+label gather over
+    the node capacity — and splits the total by fabric.  Returns
+    ``{"ici": bytes, "dcn": bytes, "topology": 'flat'|'hier'}``.  On a
+    1-D mesh everything is attributed to ICI (there is no host axis to
+    split on); the numbers are what the
+    ``glt.dist.collective_bytes{axis=}`` counters accumulate per step.
+    """
+    from ..sampler.neighbor_sampler import hop_widths, max_sampled_nodes
+
+    topo = _topology_choice(route, axis_name, mesh_shape)
+    if isinstance(axis_name, str) or mesh_shape is None:
+        h, c = 1, int(num_shards)
+    else:
+        h, c = int(mesh_shape[0]), int(mesh_shape[1])
+    widths = hop_widths(batch_size, list(num_neighbors), frontier_cap)
+    node_cap = max_sampled_nodes(batch_size, list(num_neighbors),
+                                 frontier_cap)
+    ici = dcn = 0
+    for w, fo in zip(widths, num_neighbors):
+        hc = hier_request_cap(w, c, nodes_per_shard, hier_load_factor)
+        i, d = exchange_byte_model(topo, h, c, w, 2 * fo, hier_cap=hc,
+                                   elem_bytes=elem_bytes)
+        ici += i
+        dcn += d
+    hc = hier_request_cap(node_cap, c, nodes_per_shard, hier_load_factor)
+    i, d = exchange_byte_model(topo, h, c, node_cap, feature_dim + 1,
+                               hier_cap=hc, elem_bytes=elem_bytes)
+    return {"ici": ici + i, "dcn": dcn + d, "topology": topo}
+
+
+def _byte_counters(byte_model):
+    """The per-axis collective byte counters a step increments per call."""
+    c_ici = _metrics.counter(
+        "glt.dist.collective_bytes",
+        "static per-device collective bytes moved by dist train steps, "
+        "split by fabric (from the routing plan's shapes)",
+        labels={"axis": "ici"})
+    c_dcn = _metrics.counter(
+        "glt.dist.collective_bytes",
+        "static per-device collective bytes moved by dist train steps, "
+        "split by fabric (from the routing plan's shapes)",
+        labels={"axis": "dcn"})
+
+    def record(steps=1):
+        c_ici.inc(float(byte_model["ici"] * steps))
+        c_dcn.inc(float(byte_model["dcn"] * steps))
+    return record
 
 
 def _gather_xy_local(node, rows, labels_blk, f, g, axis_name,
                      dedup_gather, route, fused, fuse_xy,
-                     fused_frontier="off"):
+                     fused_frontier="off", mesh_shape=None,
+                     hier_load_factor=None):
     """Per-shard feature+label gather for one sampled node list — the
     shared body of the serial and scanned dist train steps (one routing
     plan + one payload collective when the id spaces agree).
     ``fused_frontier`` selects the serving-side fused dedup+gather kernel
     on the FEATURE exchange (label columns are 1-wide — nothing to fuse);
-    bit-identical either way."""
+    bit-identical either way.  ``mesh_shape``/``hier_load_factor``
+    select the hierarchical topology on a 2-D mesh (tuple
+    ``axis_name``); bit-identical to flat."""
     if fuse_xy:
         x, y = exchange_gather_xy(
             node, rows, labels_blk, f.nodes_per_shard, f.num_shards,
             axis_name, dedup=dedup_gather, route=route, fused=fused,
-            fused_frontier=fused_frontier)
+            fused_frontier=fused_frontier, mesh_shape=mesh_shape,
+            hier_load_factor=hier_load_factor)
     elif dedup_gather:
         # ONE unique pass feeds both exchanges; rows/labels scatter
         # back to every original position (bit-identical batch).
@@ -60,21 +125,28 @@ def _gather_xy_local(node, rows, labels_blk, f, g, axis_name,
         x = _dedup_scatter_back(
             exchange_gather(uniq, rows, f.nodes_per_shard,
                             f.num_shards, axis_name, route=route,
-                            fused_frontier=fused_frontier),
+                            fused_frontier=fused_frontier,
+                            mesh_shape=mesh_shape,
+                            hier_load_factor=hier_load_factor),
             inv)
         y = _dedup_scatter_back(
             exchange_gather(uniq, labels_blk[:, None].astype(jnp.int32),
                             g.nodes_per_shard, g.num_shards, axis_name,
-                            route=route),
+                            route=route, mesh_shape=mesh_shape,
+                            hier_load_factor=hier_load_factor),
             inv)[:, 0]
     else:
         x = exchange_gather(node, rows, f.nodes_per_shard,
                             f.num_shards, axis_name, route=route,
-                            fused_frontier=fused_frontier)
+                            fused_frontier=fused_frontier,
+                            mesh_shape=mesh_shape,
+                            hier_load_factor=hier_load_factor)
         y = exchange_gather(node,
                             labels_blk[:, None].astype(jnp.int32),
                             g.nodes_per_shard, g.num_shards,
-                            axis_name, route=route)[:, 0]
+                            axis_name, route=route,
+                            mesh_shape=mesh_shape,
+                            hier_load_factor=hier_load_factor)[:, 0]
     return x, jnp.where(node >= 0, y, PADDING_ID)
 
 
@@ -87,7 +159,7 @@ def make_dist_train_step(
     mesh: Mesh,
     num_neighbors: Sequence[int],
     batch_size: int,
-    axis_name: str = "shard",
+    axis_name: Optional[str] = None,
     frontier_cap: Optional[int] = None,
     last_hop_dedup: bool = True,
     exchange_load_factor: Optional[float] = None,
@@ -95,6 +167,7 @@ def make_dist_train_step(
     route: str = "auto",
     fused: Optional[bool] = None,
     fused_frontier: str = "off",
+    hier_load_factor: Optional[float] = None,
 ):
     """Build ``step(state, seeds [S, B], key) -> (state, loss, acc)``.
 
@@ -118,12 +191,29 @@ def make_dist_train_step(
     requests through the one-dispatch dedup+gather kernel inside
     shard_map (sampling stays per-shard local; see
     :func:`~glt_tpu.parallel.dist_feature._request_rows`).
+
+    ``axis_name=None`` resolves to the mesh's own axes — the 1-D
+    ``global_mesh`` name or the 2-D ``global_mesh_2d`` tuple.  On a 2-D
+    mesh the step runs both sampling hops and the gather over the
+    hierarchical dedup-then-exchange topology when ``route`` resolves
+    'hier' (bit-identical to 'flat'); ``hier_load_factor`` bounds the
+    DCN leg (see :func:`~glt_tpu.parallel.dist_sampler.
+    hier_request_cap`).  The returned step carries its static
+    ``step.collective_bytes`` ICI/DCN byte model and feeds the
+    ``glt.dist.collective_bytes{axis=}`` counters per call.
     """
+    axis_name = resolve_mesh_axes(mesh, axis_name)
+    mesh_shape = mesh_axis_sizes(mesh, axis_name)
     gspec = P(axis_name)
     # Feature/label fusion needs one id space for both (always true for
     # shard_graph/shard_feature over the same node set).
     fuse_xy = (f.nodes_per_shard == g.nodes_per_shard
                and f.num_shards == g.num_shards)
+    byte_model = dist_step_byte_model(
+        g.nodes_per_shard, g.num_shards, num_neighbors, batch_size,
+        frontier_cap, f.rows.shape[-1], axis_name, mesh_shape,
+        route=route, hier_load_factor=hier_load_factor)
+    record_bytes = _byte_counters(byte_model)
 
     def local_body(indptr, indices, edge_ids, rows, labels_blk, seeds,
                    params, key):
@@ -136,13 +226,16 @@ def make_dist_train_step(
             g.nodes_per_shard, g.num_shards, axis_name, frontier_cap,
             last_hop_dedup=last_hop_dedup,
             exchange_load_factor=exchange_load_factor,
-            route=route, fused=fused)
+            route=route, fused=fused, mesh_shape=mesh_shape,
+            hier_load_factor=hier_load_factor)
         # ONE routing plan + ONE payload collective for features AND
         # labels when the id spaces agree (dedup additionally shares a
         # single unique pass) — see _gather_xy_local.
         x, y = _gather_xy_local(out.node, rows, labels_blk, f, g,
                                 axis_name, dedup_gather, route, fused,
-                                fuse_xy, fused_frontier)
+                                fuse_xy, fused_frontier,
+                                mesh_shape=mesh_shape,
+                                hier_load_factor=hier_load_factor)
         edge_index = jnp.stack([out.row, out.col])
 
         def loss_fn(p):
@@ -172,14 +265,26 @@ def make_dist_train_step(
         loss, acc, grads = shard_fn(indptr, indices, edge_ids,
                                     rows, labels_blk, seeds, state.params,
                                     key)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return TrainState(params, opt_state, state.step + 1), loss, acc
+
+        def apply(s):
+            updates, opt_state = tx.update(grads, s.opt_state, s.params)
+            params = optax.apply_updates(s.params, updates)
+            return TrainState(params, opt_state, s.step + 1)
+
+        # A fully-padded batch must not move a stateful optimizer or the
+        # step counter (same gating as the scanned step): every exchange
+        # carries only -1 slots over both fabrics, so the step is a
+        # global no-op, not a momentum-only Adam update.
+        nvalid = jnp.sum((seeds >= 0).astype(jnp.int32))
+        new_state = jax.lax.cond(nvalid > 0, apply, lambda s: s, state)
+        return new_state, loss, acc
 
     def step(state: TrainState, seeds: jnp.ndarray, key: jax.Array):
+        record_bytes()
         return _step(g.indptr, g.indices, g.edge_ids, f.rows, labels,
                      state, seeds, key)
 
+    step.collective_bytes = byte_model
     return step
 
 
@@ -192,7 +297,7 @@ def make_scanned_dist_train_step(
     mesh: Mesh,
     num_neighbors: Sequence[int],
     batch_size: int,
-    axis_name: str = "shard",
+    axis_name: Optional[str] = None,
     frontier_cap: Optional[int] = None,
     last_hop_dedup: bool = True,
     exchange_load_factor: Optional[float] = None,
@@ -200,6 +305,7 @@ def make_scanned_dist_train_step(
     route: str = "auto",
     fused: Optional[bool] = None,
     fused_frontier: str = "off",
+    hier_load_factor: Optional[float] = None,
 ):
     """ONE jitted program trains ``G`` consecutive distributed batches.
 
@@ -227,11 +333,22 @@ def make_scanned_dist_train_step(
     under the scanned dist program's compilewatch label); bit-identical
     batches, VMEM-overflowing request blocks fall back to the unfused
     serve.
+
+    On a 2-D mesh (``axis_name=None`` resolves the tuple) the scan body
+    traces the hierarchical exchange ONCE — the topology choice is
+    static, so scanning over ``dist_seed_blocks`` recompiles nothing.
     """
+    axis_name = resolve_mesh_axes(mesh, axis_name)
+    mesh_shape = mesh_axis_sizes(mesh, axis_name)
     gspec = P(axis_name)
     blkspec = P(None, axis_name)
     fuse_xy = (f.nodes_per_shard == g.nodes_per_shard
                and f.num_shards == g.num_shards)
+    byte_model = dist_step_byte_model(
+        g.nodes_per_shard, g.num_shards, num_neighbors, batch_size,
+        frontier_cap, f.rows.shape[-1], axis_name, mesh_shape,
+        route=route, hier_load_factor=hier_load_factor)
+    record_bytes = _byte_counters(byte_model)
 
     def local_body(indptr, indices, edge_ids, rows, labels_blk,
                    seeds_blk, state: TrainState, keys):
@@ -249,10 +366,13 @@ def make_scanned_dist_train_step(
                 g.nodes_per_shard, g.num_shards, axis_name, frontier_cap,
                 last_hop_dedup=last_hop_dedup,
                 exchange_load_factor=exchange_load_factor,
-                route=route, fused=fused)
+                route=route, fused=fused, mesh_shape=mesh_shape,
+                hier_load_factor=hier_load_factor)
             x, y = _gather_xy_local(out.node, rows, labels_blk, f, g,
                                     axis_name, dedup_gather, route,
-                                    fused, fuse_xy, fused_frontier)
+                                    fused, fuse_xy, fused_frontier,
+                                    mesh_shape=mesh_shape,
+                                    hier_load_factor=hier_load_factor)
             edge_index = jnp.stack([out.row, out.col])
 
             def loss_fn(p):
@@ -301,9 +421,12 @@ def make_scanned_dist_train_step(
                         seeds_blk, state, keys)
 
     def step(state: TrainState, seeds_blk: jnp.ndarray, key: jax.Array):
+        seeds_blk = jnp.asarray(seeds_blk, jnp.int32)
+        record_bytes(int(seeds_blk.shape[0]))
         return _step(g.indptr, g.indices, g.edge_ids, f.rows, labels,
-                     state, jnp.asarray(seeds_blk, jnp.int32), key)
+                     state, seeds_blk, key)
 
+    step.collective_bytes = byte_model
     return step
 
 
@@ -369,10 +492,11 @@ def make_tiered_train_step(
     labels: jnp.ndarray,          # [S, nodes_per_shard] int labels
     mesh: Mesh,
     batch_size: int,
-    axis_name: str = "shard",
+    axis_name: Optional[str] = None,
     dedup_gather: bool = False,
     route: str = "auto",
     fused: Optional[bool] = None,
+    hier_load_factor: Optional[float] = None,
 ):
     """Build the train half of the tiered two-stage pipeline.
 
@@ -395,6 +519,8 @@ def make_tiered_train_step(
     (:func:`~glt_tpu.parallel.dist_feature.exchange_gather_xy`) when the
     graph and feature id spaces agree.
     """
+    axis_name = resolve_mesh_axes(mesh, axis_name)
+    mesh_shape = mesh_axis_sizes(mesh, axis_name)
     gspec = P(axis_name)
     fuse_xy = (f.nodes_per_shard == g.nodes_per_shard
                and f.num_shards == g.num_shards)
@@ -411,17 +537,22 @@ def make_tiered_train_step(
                 out.node, hot_rows, labels_blk, f.nodes_per_shard,
                 f.num_shards, axis_name, hot_per_shard=f.hot_per_shard,
                 staged_rows=staged_rows, staged_slots=staged_slots,
-                dedup=dedup_gather, route=route, fused=fused)
+                dedup=dedup_gather, route=route, fused=fused,
+                mesh_shape=mesh_shape, hier_load_factor=hier_load_factor)
         else:
             x = exchange_gather_hot(out.node, hot_rows, f.nodes_per_shard,
                                     f.hot_per_shard, f.num_shards,
                                     axis_name, staged_rows=staged_rows,
                                     staged_slots=staged_slots,
-                                    dedup=dedup_gather, route=route)
+                                    dedup=dedup_gather, route=route,
+                                    mesh_shape=mesh_shape,
+                                    hier_load_factor=hier_load_factor)
             y = exchange_gather(out.node,
                                 labels_blk[:, None].astype(jnp.int32),
                                 g.nodes_per_shard, g.num_shards, axis_name,
-                                dedup=dedup_gather, route=route)[:, 0]
+                                dedup=dedup_gather, route=route,
+                                mesh_shape=mesh_shape,
+                                hier_load_factor=hier_load_factor)[:, 0]
         y = jnp.where(out.node >= 0, y, PADDING_ID)
         edge_index = jnp.stack([out.row, out.col])
 
@@ -664,12 +795,13 @@ class TieredTrainPipeline(_ColdStagePipeline):
 
     def __init__(self, sampler: DistNeighborSampler,
                  train_step, f: TieredShardedFeature, mesh: Mesh,
-                 axis_name: str = "shard",
+                 axis_name: Optional[str] = None,
                  cold_store: Optional[HostColdStore] = None,
                  cold_cap: Optional[int] = None,
                  stage_threads: Optional[int] = None,
                  dedup_gather: bool = False,
-                 route: str = "auto"):
+                 route: str = "auto",
+                 hier_load_factor: Optional[float] = None):
         from . import multihost
         from .dist_feature import compact_cold_requests
 
@@ -677,6 +809,8 @@ class TieredTrainPipeline(_ColdStagePipeline):
         self.train_step = train_step
         self.f = f
         self.mesh = mesh
+        axis_name = resolve_mesh_axes(mesh, axis_name)
+        mesh_shape = mesh_axis_sizes(mesh, axis_name)
         self.axis_name = axis_name
         # Compact staging capacity: cold rows staged per responder shard
         # per batch.  Worst case is S * node_cap (every request cold and
@@ -720,7 +854,8 @@ class TieredTrainPipeline(_ColdStagePipeline):
             # slots index the (possibly deduped) request layout.
             req = route_cold_requests(
                 nodes[0], f.nodes_per_shard, f.hot_per_shard,
-                f.num_shards, axis_name, dedup=dedup_gather, route=route)
+                f.num_shards, axis_name, dedup=dedup_gather, route=route,
+                mesh_shape=mesh_shape, hier_load_factor=hier_load_factor)
             slots, ids, dropped = compact_cold_requests(req, self.cold_cap)
             return slots[None], ids[None], dropped[None]
 
@@ -811,9 +946,10 @@ def make_hetero_dist_train_step(
     labels: jnp.ndarray,          # [S, c_target] target-type labels
     mesh: Mesh,
     batch_size: int,
-    axis_name: str = "shard",
+    axis_name: Optional[str] = None,
     route: str = "auto",
     fused: Optional[bool] = None,
+    hier_load_factor: Optional[float] = None,
 ):
     """Hetero analog of :func:`make_dist_train_step` (cf. the reference's
     igbh distributed run, examples/igbh/dist_train_rgat.py): hetero
@@ -826,6 +962,8 @@ def make_hetero_dist_train_step(
     feature gather and the label gather share one routing plan + one
     fused payload collective (``exchange_gather_xy``).
     """
+    axis_name = resolve_mesh_axes(mesh, axis_name)
+    mesh_shape = mesh_axis_sizes(mesh, axis_name)
     gspec = P(axis_name)
     tgt = sampler.input_type
     arrays = {et: (g.indptr, g.indices, g.edge_ids)
@@ -850,15 +988,20 @@ def make_hetero_dist_train_step(
             if t == tgt and fuse_xy:
                 x[t], y = exchange_gather_xy(
                     out.node[t], rows_l[t], labels_l, meta[t][0],
-                    meta[t][1], axis_name, route=route, fused=fused)
+                    meta[t][1], axis_name, route=route, fused=fused,
+                    mesh_shape=mesh_shape,
+                    hier_load_factor=hier_load_factor)
             else:
                 x[t] = exchange_gather(out.node[t], rows_l[t], meta[t][0],
-                                       meta[t][1], axis_name, route=route)
+                                       meta[t][1], axis_name, route=route,
+                                       mesh_shape=mesh_shape,
+                                       hier_load_factor=hier_load_factor)
         if y is None:
             y = exchange_gather(out.node[tgt],
                                 labels_l[:, None].astype(jnp.int32),
                                 label_c, num_shards, axis_name,
-                                route=route)[:, 0]
+                                route=route, mesh_shape=mesh_shape,
+                                hier_load_factor=hier_load_factor)[:, 0]
         y = jnp.where(out.node[tgt] >= 0, y, PADDING_ID)
         edge_index = {et: jnp.stack([out.row[et], out.col[et]])
                       for et in out.row}
@@ -908,9 +1051,10 @@ def make_hetero_tiered_train_step(
     labels: jnp.ndarray,          # [S, c_target] target-type labels
     mesh: Mesh,
     batch_size: int,
-    axis_name: str = "shard",
+    axis_name: Optional[str] = None,
     route: str = "auto",
     fused: Optional[bool] = None,
+    hier_load_factor: Optional[float] = None,
 ):
     """Hetero analog of :func:`make_tiered_train_step` (VERDICT r4 #4):
     node types whose feature is a :class:`TieredShardedFeature` (e.g.
@@ -924,6 +1068,8 @@ def make_hetero_tiered_train_step(
     ``{node_type: (rows [S, cold_cap, d], slots [S, cold_cap])}`` for the
     tiered types only.
     """
+    axis_name = resolve_mesh_axes(mesh, axis_name)
+    mesh_shape = mesh_axis_sizes(mesh, axis_name)
     gspec = P(axis_name)
     tgt = sampler.input_type
     tiered = sorted(t for t, f in feats.items()
@@ -957,21 +1103,28 @@ def make_hetero_tiered_train_step(
                 x[t], y = exchange_gather_xy(
                     out.node[t], hot_l[t], labels_l, c, s, axis_name,
                     hot_per_shard=h, staged_rows=srows.get(t),
-                    staged_slots=sslots.get(t), route=route, fused=fused)
+                    staged_slots=sslots.get(t), route=route, fused=fused,
+                    mesh_shape=mesh_shape,
+                    hier_load_factor=hier_load_factor)
             elif t in srows:
                 x[t] = exchange_gather_hot(out.node[t], hot_l[t], c, h, s,
                                            axis_name,
                                            staged_rows=srows[t],
                                            staged_slots=sslots[t],
-                                           route=route)
+                                           route=route,
+                                           mesh_shape=mesh_shape,
+                                           hier_load_factor=hier_load_factor)
             else:
                 x[t] = exchange_gather(out.node[t], hot_l[t], c, s,
-                                       axis_name, route=route)
+                                       axis_name, route=route,
+                                       mesh_shape=mesh_shape,
+                                       hier_load_factor=hier_load_factor)
         if y is None:
             y = exchange_gather(out.node[tgt],
                                 labels_l[:, None].astype(jnp.int32),
                                 label_c, num_shards, axis_name,
-                                route=route)[:, 0]
+                                route=route, mesh_shape=mesh_shape,
+                                hier_load_factor=hier_load_factor)[:, 0]
         y = jnp.where(out.node[tgt] >= 0, y, PADDING_ID)
         edge_index = {et: jnp.stack([out.row[et], out.col[et]])
                       for et in out.row}
@@ -1026,16 +1179,19 @@ class HeteroTieredTrainPipeline(_ColdStagePipeline):
     """
 
     def __init__(self, sampler, train_step, feats, mesh: Mesh,
-                 axis_name: str = "shard",
+                 axis_name: Optional[str] = None,
                  cold_caps=None,
                  stage_threads: Optional[int] = None,
-                 route: str = "auto"):
+                 route: str = "auto",
+                 hier_load_factor: Optional[float] = None):
         from . import multihost
         from .dist_feature import compact_cold_requests
 
         self.sampler = sampler
         self.train_step = train_step
         self.mesh = mesh
+        axis_name = resolve_mesh_axes(mesh, axis_name)
+        mesh_shape = mesh_axis_sizes(mesh, axis_name)
         self.axis_name = axis_name
         self.tiered = {t: f for t, f in feats.items()
                        if isinstance(f, TieredShardedFeature)}
@@ -1066,7 +1222,9 @@ class HeteroTieredTrainPipeline(_ColdStagePipeline):
                 f = self.tiered[t]
                 req = route_cold_requests(
                     nodes_blk[t][0], f.nodes_per_shard, f.hot_per_shard,
-                    f.num_shards, axis_name, route=route)
+                    f.num_shards, axis_name, route=route,
+                    mesh_shape=mesh_shape,
+                    hier_load_factor=hier_load_factor)
                 s, i, d = compact_cold_requests(req, self.cold_cap[t])
                 slots[t], ids[t], dropped[t] = s[None], i[None], d[None]
             return slots, ids, dropped

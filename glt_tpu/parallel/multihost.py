@@ -184,6 +184,85 @@ def global_mesh(axis_name: str = "shard") -> Mesh:
     return Mesh(np.array(jax.devices()), (axis_name,))
 
 
+def global_mesh_2d(host_axis: str = "host", chip_axis: str = "chip",
+                   num_hosts: Optional[int] = None) -> Mesh:
+    """Two-axis ``(host, chip)`` mesh: devices reshaped
+    ``[n_hosts, chips_per_host]`` in flat device order.
+
+    The hierarchical router (:class:`~glt_tpu.parallel.dist_sampler.
+    HierarchicalRouting`) reads the fabric off the axis names: the
+    ``chip_axis`` rows ride ICI, the ``host_axis`` columns ride DCN.
+    Because the grid is a row-major reshape of ``jax.devices()``, shard
+    ``s`` of a dim-0-sharded array lands on grid cell
+    ``(s // chips_per_host, s % chips_per_host)`` — flat-path code
+    addressing the combined ``(host_axis, chip_axis)`` axis sees exactly
+    the 1-D :func:`global_mesh` device order.
+
+    Args:
+      num_hosts: mesh rows; defaults to ``jax.process_count()`` (one row
+        per process — the physical layout).  Override to emulate a pod
+        shape, e.g. a single 8-device process testing a 2x4 mesh.
+
+    Raises:
+      ValueError: device count not divisible by ``num_hosts``, or a
+        process's devices straddle a host-row boundary without covering
+        whole rows (per-axis contiguity — required so per-host feeding
+        keeps addressing contiguous flat shard ranges).
+    """
+    devs = np.array(jax.devices())
+    n = devs.size
+    h = jax.process_count() if num_hosts is None else int(num_hosts)
+    if h <= 0 or n % h:
+        raise ValueError(
+            f"cannot reshape {n} devices onto {h} mesh rows "
+            f"({host_axis!r} axis): not divisible")
+    c = n // h
+    grid = devs.reshape(h, c)
+    # Per-axis contiguity: every host row must be a union of whole
+    # process blocks, or every process block a union of whole rows —
+    # otherwise some process would own a non-contiguous slice of a row
+    # and the arithmetic partition book breaks down.
+    for r in range(h):
+        procs = {d.process_index for d in grid[r]}
+        if len(procs) > 1:
+            for p in procs:
+                owned = [i for i, d in enumerate(devs)
+                         if d.process_index == p]
+                row_slice = set(range(r * c, (r + 1) * c))
+                if not row_slice.issuperset(owned) and \
+                        not row_slice.issubset(owned):
+                    raise ValueError(
+                        f"process {p} devices straddle mesh row {r} of "
+                        f"axes ({host_axis!r}, {chip_axis!r}): it owns "
+                        f"flat device slots {owned}, row {r} spans "
+                        f"{sorted(row_slice)}; pick num_hosts so host "
+                        f"rows align with process boundaries")
+    return Mesh(grid, (host_axis, chip_axis))
+
+
+def mesh_axes(mesh: Mesh):
+    """The dim-0 sharding spec for ``mesh``: its axis name (1-D) or the
+    full axis-name tuple (N-D, sharding dim 0 over all axes row-major).
+
+    This is what makes every helper below 2-D-aware: a
+    ``(host, chip)`` mesh shards dim 0 over both axes in flat device
+    order, so per-host feeding and shard arithmetic are unchanged.
+    """
+    names = tuple(mesh.axis_names)
+    return names[0] if len(names) == 1 else names
+
+
+def _dim0_spec(mesh: Mesh, axis_name):
+    """Resolve a (possibly stale 1-D) ``axis_name`` against ``mesh``."""
+    names = tuple(mesh.axis_names)
+    if isinstance(axis_name, str) and len(names) == 1 \
+            and axis_name in names:
+        return axis_name
+    if isinstance(axis_name, tuple) and tuple(axis_name) == names:
+        return axis_name
+    return mesh_axes(mesh)
+
+
 def local_shard_range(mesh: Mesh, axis_name: str = "shard") -> range:
     """Global shard indices whose device lives in this process.
 
@@ -200,9 +279,16 @@ def local_shard_range(mesh: Mesh, axis_name: str = "shard") -> range:
         return range(0)
     lo, hi = min(mine), max(mine) + 1
     if mine != list(range(lo, hi)):
+        axes = tuple(mesh.axis_names)
+        offending = [getattr(devs[i], "id", i) for i in mine]
         raise ValueError(
-            f"local devices are not contiguous on mesh axis {axis_name!r}: "
-            f"{mine}")
+            f"local devices are not contiguous on mesh axes {axes!r} "
+            f"(shape {tuple(mesh.devices.shape)}): process "
+            f"{jax.process_index()} owns flat shard slots {mine} "
+            f"(device ids {offending}), expected one contiguous run — "
+            f"rebuild the mesh with global_mesh/global_mesh_2d so each "
+            f"process's devices form a contiguous block in flat "
+            f"(row-major) device order")
     return range(lo, hi)
 
 
@@ -212,9 +298,11 @@ def assemble_global(local_block: np.ndarray, mesh: Mesh,
 
     Every process calls this with its own shards' slab; the result is one
     logical array sharded over ``axis_name`` whose device-local data never
-    crossed hosts.
+    crossed hosts.  On a multi-axis mesh, dim 0 is sharded over *all*
+    axes in row-major order (see :func:`mesh_axes`), so the flat shard
+    numbering is identical to the 1-D case.
     """
-    sharding = NamedSharding(mesh, P(axis_name))
+    sharding = NamedSharding(mesh, P(_dim0_spec(mesh, axis_name)))
     num_shards = mesh.devices.size
     global_shape = (num_shards,) + tuple(local_block.shape[1:])
     return jax.make_array_from_process_local_data(

@@ -139,6 +139,136 @@ def run_hetero_steps(mesh, num_steps: int):
     return losses
 
 
+def run_hier_steps(mesh, num_steps: int):
+    """Flat vs hierarchical routing on a 2-D (host, chip) fleet mesh.
+
+    One process computes BOTH routes so the parent can assert exact
+    (byte-level) equality: per-step losses, a sha256 digest of the final
+    params, an all-padded-step no-op probe (the -1 seed must stay inert
+    across both sampling hops and both fabrics), and the measured dedup
+    factor of a zipf-skewed frontier (flat request slots / host-unique
+    DCN slots).
+    """
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from glt_tpu.data.topology import CSRTopo
+    from glt_tpu.models import GraphSAGE
+    from glt_tpu.parallel import multihost
+    from glt_tpu.parallel.dist_sampler import (
+        build_hier_routing,
+        mesh_axis_sizes,
+        resolve_mesh_axes,
+    )
+    from glt_tpu.parallel.dist_train import (
+        init_dist_state,
+        make_dist_train_step,
+    )
+
+    n_dev = mesh.devices.size
+    axis_name = resolve_mesh_axes(mesh)
+    h, c = mesh_axis_sizes(mesh, axis_name)
+    edge_index, n, feat, labels, classes, seeds = build_fixture(n_dev)
+    seeds = seeds.copy()
+    seeds[0, -1] = -1          # a padded slot rides every step
+    topo = CSRTopo(edge_index, num_nodes=n)
+    g = multihost.shard_graph_global(topo, mesh)
+    f = multihost.shard_feature_global(feat, mesh)
+    lab = multihost.labels_global(labels, mesh, g.nodes_per_shard)
+    model = GraphSAGE(hidden_features=16, out_features=classes,
+                      num_layers=2, dropout_rate=0.0)
+    tx = optax.adam(1e-3)
+    batch_size, fanouts = 4, [2, 2]
+
+    out = {}
+    digests = {}
+    for route in ("flat", "hier"):
+        state = init_dist_state(model, tx, g, f, jax.random.PRNGKey(0),
+                                fanouts, batch_size)
+        step = make_dist_train_step(model, tx, g, f, lab, mesh, fanouts,
+                                    batch_size, route=route)
+        losses = []
+        for i in range(num_steps):
+            sd = multihost.feed_seeds(seeds, mesh)
+            state, loss, acc = step(state, sd, jax.random.PRNGKey(i + 1))
+            losses.append(float(np.asarray(jax.device_get(loss))))
+        out[route] = losses
+        leaves = [np.asarray(jax.device_get(x)).tobytes()
+                  for x in jax.tree_util.tree_leaves(state.params)]
+        digests[route] = hashlib.sha256(b"".join(leaves)).hexdigest()
+        # An all-padded step must not move params or the step counter:
+        # every exchange over both hops carries only padding on both the
+        # ICI and the DCN legs.
+        pad = multihost.feed_seeds(np.full_like(seeds, -1), mesh)
+        st2, _, _ = step(state, pad, jax.random.PRNGKey(99))
+        leaves2 = [np.asarray(jax.device_get(x)).tobytes()
+                   for x in jax.tree_util.tree_leaves(st2.params)]
+        out[f"pad_noop_{route}"] = bool(
+            leaves == leaves2
+            and int(jax.device_get(st2.step)) ==
+            int(jax.device_get(state.step)))
+    out["params_equal"] = digests["flat"] == digests["hier"]
+    out["byte_model"] = {
+        r: dict(make_dist_train_step(
+            model, tx, g, f, lab, mesh, fanouts, batch_size,
+            route=r).collective_bytes)
+        for r in ("flat", "hier")}
+
+    # Measured dedup on a zipf-skewed frontier: how many flat request
+    # slots collapse into host-unique DCN slots.
+    rng = np.random.default_rng(0)
+    zipf = np.minimum(
+        rng.zipf(1.5, size=(n_dev, 32)).astype(np.int32) - 1, n - 1)
+
+    def count(i_blk):
+        hr = build_hier_routing(i_blk[0], g.nodes_per_shard, h, c,
+                                axis_name[0], axis_name[1])
+        flat_slots = lax.psum(
+            jnp.sum((hr.base.buckets >= 0).astype(jnp.int32)), axis_name)
+        uniq_slots = lax.psum(
+            jnp.sum((hr.uniq >= 0).astype(jnp.int32)), axis_name)
+        return jnp.stack([flat_slots, uniq_slots])
+
+    fn = jax.jit(jax.shard_map(
+        count, mesh=mesh, in_specs=(P(axis_name),), out_specs=P(),
+        check_vma=False))
+    counts = np.asarray(jax.device_get(
+        fn(multihost.feed_seeds(zipf, mesh))))
+    out["hier_dedup_factor"] = float(counts[0]) / float(max(counts[1], 1))
+    return out
+
+
+def run_barrier_probe(num_hosts: int):
+    """barrier() deadline behavior on the 2-D fleet mesh: everyone joins
+    one barrier, then process 0 never enters the late barrier — every
+    peer's deadline must expire as a structured BarrierTimeoutError, not
+    a hang."""
+    import time
+
+    import jax
+
+    from glt_tpu.distributed.supervisor import BarrierTimeoutError
+    from glt_tpu.parallel import multihost
+
+    mesh = multihost.global_mesh_2d(num_hosts=num_hosts)
+    assert tuple(mesh.axis_names) == ("host", "chip")
+    multihost.barrier("hier-fleet-up")
+    if jax.process_index() == 0:
+        time.sleep(6.0)
+        return {"timed_out": False}
+    try:
+        multihost.barrier("hier-late", timeout_s=2.0)
+        return {"timed_out": False}
+    except BarrierTimeoutError:
+        return {"timed_out": True}
+
+
 def make_partition_dir(part_dir: str, n_total_devices: int) -> None:
     """Partition the fixture graph (graph + features) into ``part_dir``."""
     from glt_tpu.partition import RandomPartitioner
@@ -211,6 +341,21 @@ def main():
                          num_processes=nproc, process_id=proc_id)
     assert jax.process_count() == nproc, jax.process_count()
     assert len(jax.devices()) == nproc * ndev
+
+    if mode.startswith("barrier:"):
+        result = run_barrier_probe(int(mode.split(":", 1)[1]))
+        print(json.dumps({"proc": proc_id, **result}), flush=True)
+        sys.stdout.flush()
+        # The abandoned barrier thread (procs that timed out) and the
+        # coordinator teardown can both block a normal exit — the probe
+        # already proved what it needed to.
+        os._exit(0)
+    if mode.startswith("hier:"):
+        mesh = multihost.global_mesh_2d(
+            num_hosts=int(mode.split(":", 1)[1]))
+        result = run_hier_steps(mesh, steps)
+        print(json.dumps({"proc": proc_id, **result}), flush=True)
+        return
 
     mesh = multihost.global_mesh()
     if mode.startswith("dataset:"):

@@ -2244,6 +2244,58 @@ class TestUnknownAxisName:
         """
         assert findings_for(src, "unknown-axis-name") == []
 
+    def test_stale_flat_axis_on_2d_mesh_fires(self):
+        """ISSUE 17 fixture: a body migrated to the 2-D (host, chip)
+        mesh but still carrying the 1-D era's "shard" axis string is
+        exactly the bug hierarchical routing introduces — the collective
+        compiles against no axis and GLT021 must name both the stale
+        string and the real axes."""
+        src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def run(xs):
+            mesh = Mesh(np.array(jax.devices()).reshape(2, -1),
+                        ("host", "chip"))
+
+            def body(x):
+                x = jax.lax.all_to_all(x, "chip", 0, 0)
+                return jax.lax.psum(x, "shard")
+
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=P(("host", "chip")),
+                                 out_specs=P(("host", "chip")))(xs)
+        """
+        out = findings_for(src, "unknown-axis-name")
+        assert len(out) == 1
+        assert "'shard'" in out[0].message
+        assert "'host'" in out[0].message and "'chip'" in out[0].message
+
+    def test_hier_exchange_on_2d_mesh_clean(self):
+        """The sanctioned hierarchical pattern — intra-host all_to_all
+        over the ICI axis, dedup, cross-host all_to_all over the DCN
+        axis, tuple specs over both axes — produces no findings."""
+        src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def run(xs):
+            mesh = Mesh(np.array(jax.devices()).reshape(2, -1),
+                        ("host", "chip"))
+
+            def body(x):
+                x = jax.lax.all_to_all(x, "chip", 0, 0)
+                x = jax.lax.all_to_all(x, "host", 0, 0)
+                return jax.lax.psum(x, ("host", "chip"))
+
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=P(("host", "chip")),
+                                 out_specs=P(("host", "chip")))(xs)
+        """
+        assert findings_for(src, "unknown-axis-name") == []
+
     def test_literal_forwarded_into_helper(self):
         """One transitive step: a literal axis string passed into a
         module function that forwards it to a collective."""

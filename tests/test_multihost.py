@@ -31,9 +31,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _spawn_fleet(nproc: int, ndev: int, steps: int = STEPS,
-                 mode: str = "train"):
-    """Run the worker fleet; returns per-process loss lists."""
+def _spawn_fleet_raw(nproc: int, ndev: int, steps: int = STEPS,
+                     mode: str = "train"):
+    """Run the worker fleet; returns per-process result dicts."""
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
@@ -55,7 +55,14 @@ def _spawn_fleet(nproc: int, ndev: int, steps: int = STEPS,
         assert p.returncode == 0, f"worker {i} failed:\n{err[-4000:]}"
         line = [ln for ln in out.splitlines() if ln.startswith("{")][-1]
         outs.append(json.loads(line))
-    return [o["losses"] for o in sorted(outs, key=lambda o: o["proc"])]
+    return sorted(outs, key=lambda o: o["proc"])
+
+
+def _spawn_fleet(nproc: int, ndev: int, steps: int = STEPS,
+                 mode: str = "train"):
+    """Run the worker fleet; returns per-process loss lists."""
+    return [o["losses"]
+            for o in _spawn_fleet_raw(nproc, ndev, steps, mode)]
 
 
 @pytest.fixture(scope="module")
@@ -91,6 +98,48 @@ def test_two_process_hetero_matches_single_process():
     per_proc = _spawn_fleet(nproc=2, ndev=4, steps=2, mode="hetero")
     assert per_proc[0] == pytest.approx(per_proc[1], rel=0, abs=0)
     assert per_proc[0] == pytest.approx(ref, rel=1e-5)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("nproc,ndev,num_hosts", [(4, 1, 2)])
+def test_four_process_hier_fleet_bit_identity(nproc, ndev, num_hosts):
+    """4-process (2 host x 2 chip) gloo fleet on the 2-D mesh:
+    route='hier' is byte-identical to route='flat' (losses AND final
+    params), padded -1 seeds stay inert across both hops, the static
+    byte model shows the DCN reduction, and the zipf-skewed frontier
+    actually dedups (> 1x).  Slow: compiles two dist programs in each
+    of 4 processes — CI runs it in the microbench-smoke job."""
+    from jax.sharding import Mesh
+
+    from _multihost_worker import run_hier_steps
+
+    n_dev = nproc * ndev
+    outs = _spawn_fleet_raw(nproc=nproc, ndev=ndev, steps=2,
+                            mode=f"hier:{num_hosts}")
+    for o in outs:
+        assert o["flat"] == o["hier"]          # exact float equality
+        assert o["params_equal"]               # sha256 over raw bytes
+        assert o["pad_noop_flat"] and o["pad_noop_hier"]
+        assert o["hier_dedup_factor"] > 1.0
+        assert o["byte_model"]["hier"]["dcn"] < o["byte_model"]["flat"]["dcn"]
+    # Every process observes the same replicated losses...
+    assert all(o["flat"] == outs[0]["flat"] for o in outs)
+    # ...matching the in-process run of the same 2-D program.
+    mesh = Mesh(np.array(jax.devices()[:n_dev]).reshape(num_hosts, -1),
+                ("host", "chip"))
+    ref = run_hier_steps(mesh, 2)
+    assert ref["flat"] == ref["hier"] and ref["params_equal"]
+    assert outs[0]["flat"] == pytest.approx(ref["flat"], rel=1e-5)
+
+
+@pytest.mark.slow
+def test_four_process_barrier_deadline_on_2d_mesh():
+    """A straggler on the 4-process 2-D mesh turns every peer's
+    barrier() into a structured BarrierTimeoutError at the deadline —
+    never a hang."""
+    outs = _spawn_fleet_raw(nproc=4, ndev=1, steps=0, mode="barrier:2")
+    assert outs[0]["timed_out"] is False       # the straggler itself
+    assert all(o["timed_out"] for o in outs[1:])
 
 
 def test_two_process_dataset_load_matches_single_process(tmp_path):
