@@ -32,6 +32,15 @@ all-DRAM path, and the record carries the acceptance metrics —
 ``store_epoch_ms``, ``dram_hit_rate``, ``bytes_from_{hbm,dram,disk}``,
 ``disk_bytes_per_epoch``, ``budget_ok``, ``store_bit_identical``.
 
+Two further sections (ISSUE 18, docs/refresh.md + docs/storage.md
+"Compressed tiers"): ``--codec-rows > 0`` runs the per-codec gather A/B
+(raw vs bf16 vs int8 HBM tables, ``gather_gb_s_effective_*`` = logical
+f32 bytes/sec, speedup ratios vs raw), and ``--refresh-rows > 0`` runs
+the layer-wise whole-graph refresh driver over a store >= 4x its DRAM
+budget, raw and int8 side by side — ``refresh_nodes_per_s``,
+``refresh_bytes_from_{hbm,dram,disk}``, ``refresh_stage_errors``,
+``dram_hit_rate`` and the compressed/raw output parity.
+
 Prints one JSON line per record (also written, one line each, atomically
 to $GLT_BENCH_OUT).
 """
@@ -83,6 +92,22 @@ def main():
                          "feature")
     ap.add_argument("--store-batches", type=int, default=64)
     ap.add_argument("--store-batch", type=int, default=512)
+    ap.add_argument("--codec-rows", type=int, default=32768,
+                    help="per-codec gather A/B section: HBM table rows "
+                         "(0 skips the section)")
+    ap.add_argument("--codec-dim", type=int, default=128)
+    ap.add_argument("--codec-batch", type=int, default=8192)
+    ap.add_argument("--codec-iters", type=int, default=16)
+    ap.add_argument("--refresh-rows", type=int, default=16384,
+                    help="whole-graph refresh section: graph nodes "
+                         "(0 skips the section)")
+    ap.add_argument("--refresh-dim", type=int, default=64)
+    ap.add_argument("--refresh-degree", type=int, default=8)
+    ap.add_argument("--refresh-layers", type=int, default=2)
+    ap.add_argument("--refresh-block", type=int, default=512)
+    ap.add_argument("--refresh-budget-frac", type=float, default=0.25,
+                    help="refresh DRAM budget as a fraction of the input "
+                         "store's bytes (0.25 = store is 4x the budget)")
     args = ap.parse_args()
 
     import jax
@@ -278,6 +303,16 @@ def main():
         results.append(rec)
         print(json.dumps(rec), flush=True)
 
+    if args.codec_rows > 0:
+        rec = _bench_codec_gather(args)
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    if args.refresh_rows > 0:
+        rec = _bench_refresh(args)
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
     bench_out = os.environ.get("GLT_BENCH_OUT")
     if bench_out:
         tmp = f"{bench_out}.tmp-{os.getpid()}"
@@ -365,6 +400,130 @@ def _bench_disk_store(args):
             "disk_bytes_per_epoch": int(epoch["bytes_from_disk"]),
             "stage_depth_max": int(epoch["stage_depth_max"]),
         }
+    return rec
+
+
+def _bench_codec_gather(args):
+    """Per-codec gather A/B: effective (logical f32) bandwidth.
+
+    The compressed tiers move 2x (bf16) / 4x (int8) fewer wire bytes
+    per row and widen on-chip in the gather epilogue, so the honest
+    comparison is LOGICAL bytes per second — the f32 payload the model
+    consumes, whatever width crossed the bus.  The
+    ``gather_effective_speedup_*`` ratios carry the >=2x int8
+    aspiration (obs.regress); on the CPU backend they mostly price the
+    dequant epilogue, on TPU they price the HBM transfer win.
+    """
+    import jax.numpy as jnp
+
+    from glt_tpu.data.feature import Feature
+    from glt_tpu.store import DiskFeatureStore, write_feature_store
+
+    n, d = args.codec_rows, args.codec_dim
+    rng = np.random.default_rng(11)
+    feats = rng.normal(size=(n, d)).astype(np.float32)
+    ids = jnp.asarray(rng.integers(0, n, args.codec_batch), jnp.int32)
+    rec = {"metric": "codec_gather", "codec_rows": n, "codec_dim": d,
+           "codec_batch": args.codec_batch}
+    eff = {}
+    with tempfile.TemporaryDirectory() as td:
+        for codec in ("raw", "bf16", "int8"):
+            root = os.path.join(td, codec)
+            write_feature_store(root, feats, codec=codec)
+            feat = Feature.from_store(DiskFeatureStore(root),
+                                      dram_budget_bytes=1 << 20,
+                                      split_ratio=1.0)
+            feat.gather(ids).block_until_ready()          # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(args.codec_iters):
+                out = feat.gather(ids)
+            out.block_until_ready()
+            dt = time.perf_counter() - t0
+            logical = args.codec_iters * int(ids.size) * d * 4
+            eff[codec] = logical / dt / 1e9
+            rec[f"gather_gb_s_effective_{codec}"] = round(eff[codec], 3)
+            feat.close()
+    rec["gather_effective_speedup_bf16"] = round(
+        eff["bf16"] / max(eff["raw"], 1e-9), 3)
+    rec["gather_effective_speedup_int8"] = round(
+        eff["int8"] / max(eff["raw"], 1e-9), 3)
+    return rec
+
+
+def _bench_refresh(args):
+    """Whole-graph refresh over a store >= 4x the DRAM budget.
+
+    Runs the layer-wise driver twice — raw f32 input store and int8 —
+    and records throughput, per-tier byte counts, staging health and
+    the compressed/raw output parity (relative max error over the final
+    embeddings).  The graph's neighbors are window-local, the layout a
+    partition-sorted node ordering produces, so the block-ahead
+    prefetch keeps the DRAM hit rate meaningful at any budget.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from glt_tpu.models.sage import GraphSAGE
+    from glt_tpu.refresh import RefreshDriver, sage_refresh_layers
+    from glt_tpu.store import DiskFeatureStore, write_feature_store
+
+    n, d = args.refresh_rows, args.refresh_dim
+    rng = np.random.default_rng(13)
+    deg = rng.integers(1, args.refresh_degree + 1, n)
+    indptr = np.zeros(n + 1, np.int64)
+    indptr[1:] = np.cumsum(deg)
+    window = max(4 * args.refresh_block, 64)
+    offsets = rng.integers(-window, window, indptr[-1])
+    owners = np.repeat(np.arange(n, dtype=np.int64), deg)
+    indices = (owners + offsets) % n
+    feats = rng.normal(size=(n, d)).astype(np.float32)
+    budget = max(1, int(feats.nbytes * args.refresh_budget_frac))
+
+    model = GraphSAGE(hidden_features=d, out_features=d // 2,
+                      num_layers=args.refresh_layers, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, d)),
+                        jnp.zeros((2, 1), jnp.int32),
+                        jnp.ones((1,), bool))
+    fns = sage_refresh_layers(model, params)
+
+    def run(codec, td):
+        root = os.path.join(td, f"in_{codec}")
+        write_feature_store(root, feats, codec=codec)
+        drv = RefreshDriver(
+            indptr, indices, fns, DiskFeatureStore(root),
+            os.path.join(td, f"out_{codec}"),
+            block_size=args.refresh_block,
+            max_degree=args.refresh_degree,
+            dram_budget_bytes=budget, stage_threads=2)
+        rep = drv.run()
+        emb = DiskFeatureStore(rep["out_root"]).read_rows(
+            np.arange(n, dtype=np.int64))
+        return rep, emb
+
+    with tempfile.TemporaryDirectory() as td:
+        rep_raw, emb_raw = run("raw", td)
+        rep_q, emb_q = run("int8", td)
+
+    scale = max(float(np.abs(emb_raw).max()), 1e-9)
+    rec = {
+        "metric": "refresh",
+        "refresh_rows": n,
+        "refresh_dim": d,
+        "refresh_layers": args.refresh_layers,
+        "refresh_block": args.refresh_block,
+        "refresh_budget_bytes": budget,
+        "refresh_store_bytes": int(feats.nbytes),
+        "refresh_nodes_per_s": round(rep_q["nodes_per_s"], 1),
+        "refresh_nodes_per_s_raw": round(rep_raw["nodes_per_s"], 1),
+        "refresh_bytes_from_hbm": int(rep_q["bytes_from_hbm"]),
+        "refresh_bytes_from_dram": int(rep_q["bytes_from_dram"]),
+        "refresh_bytes_from_disk": int(rep_q["bytes_from_disk"]),
+        "refresh_stage_errors": int(rep_raw["stage_errors"]
+                                    + rep_q["stage_errors"]),
+        "dram_hit_rate": round(rep_q["dram_hit_rate"], 4),
+        "refresh_parity_rel_err": round(
+            float(np.abs(emb_q - emb_raw).max()) / scale, 5),
+    }
     return rec
 
 

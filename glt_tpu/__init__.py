@@ -35,7 +35,7 @@ from .typing import EdgeType, NodeType, PADDING_ID  # noqa: F401
 # and usable for pure-host tooling (partitioning scripts etc.).
 _SUBMODULES = ("data", "ops", "sampler", "loader", "models", "parallel",
                "partition", "distributed", "channel", "ckpt", "obs",
-               "serving", "store", "utils", "testing")
+               "refresh", "serving", "store", "utils", "testing")
 
 
 def __getattr__(name):

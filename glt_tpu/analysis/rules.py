@@ -1609,6 +1609,92 @@ class UnbalancedProfilerCapture(Rule):
                     balanced.add(id(n))
 
 
+# ---------------------------------------------------------------------------
+# GLT022 lossy-dtype-narrowing
+# ---------------------------------------------------------------------------
+
+@register
+class LossyDtypeNarrowing(Rule):
+    """Narrowing ``.astype`` casts on feature-path arrays outside the
+    codec module.
+
+    Feature compression is centralized in ``glt_tpu/store/quant.py``:
+    its codecs carry per-column scale/zero metadata in the store
+    manifest and meet a bounded-error contract, and the gather
+    epilogues widen back to the logical dtype on-chip.  A bare
+    ``x.astype(np.float16)`` / ``.astype(jnp.bfloat16)`` /
+    ``.astype("int8")`` elsewhere silently discards precision with no
+    metadata to undo it — the error neither shows up in the manifest
+    nor in the parity suites that compare the raw and compressed arms.
+    Route narrowing through a quant codec (or keep it inside
+    ``store/quant.py`` where the contract is tested).
+    """
+    name = "lossy-dtype-narrowing"
+    code = "GLT022"
+    severity = Severity.ERROR
+    description = ("bare narrowing .astype() on arrays outside "
+                   "store/quant.py (precision silently discarded with no "
+                   "codec metadata to dequantize)")
+
+    # Sub-f32 floats and sub-i32 ints: casts that drop mantissa or
+    # range.  int32 itself stays legal — ids are relabeled into int32
+    # range deliberately (GLT004 owns that hazard).
+    _NARROW = {
+        "numpy.float16", "jax.numpy.float16",
+        "jax.numpy.bfloat16", "ml_dtypes.bfloat16",
+        "numpy.int8", "jax.numpy.int8",
+        "numpy.uint8", "jax.numpy.uint8",
+        "numpy.int16", "jax.numpy.int16",
+        "numpy.uint16", "jax.numpy.uint16",
+        "jax.numpy.float8_e4m3fn", "jax.numpy.float8_e5m2",
+        "ml_dtypes.float8_e4m3fn", "ml_dtypes.float8_e5m2",
+    }
+    _NARROW_STRINGS = {
+        "float16", "bfloat16", "int8", "uint8", "int16", "uint16",
+        "float8_e4m3fn", "float8_e5m2",
+    }
+    _EXEMPT_SUFFIX = ("store/quant.py", "store\\quant.py")
+
+    def _narrow_target(self, module: ModuleInfo,
+                       arg: ast.expr) -> Optional[str]:
+        resolved = module.imports.resolve(arg)
+        if resolved in self._NARROW:
+            return resolved
+        if (isinstance(arg, ast.Constant)
+                and arg.value in self._NARROW_STRINGS):
+            return str(arg.value)
+        # np.dtype("float16") / jnp.dtype(...) wrappers
+        if isinstance(arg, ast.Call):
+            name = module.call_name(arg) or ""
+            if name in ("numpy.dtype", "jax.numpy.dtype") and arg.args:
+                return self._narrow_target(module, arg.args[0])
+        return None
+
+    def check(self, module: ModuleInfo, project=None
+              ) -> List[Finding]:
+        path = module.path.replace("\\", "/")
+        if path.endswith("store/quant.py") or getattr(
+                module, "module_name", "").endswith("store.quant"):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"
+                    and node.args):
+                continue
+            target = self._narrow_target(module, node.args[0])
+            if target is None:
+                continue
+            findings.append(self.finding(
+                module, node,
+                f"narrowing cast .astype({target}) outside "
+                f"store/quant.py: precision is dropped with no codec "
+                f"metadata to dequantize — encode through a "
+                f"glt_tpu.store.quant codec instead"))
+        return findings
+
+
 def _iter_const_ints(node: ast.expr) -> Iterator[int]:
     if isinstance(node, ast.Constant) and isinstance(node.value, int):
         yield node.value

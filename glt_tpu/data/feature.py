@@ -109,6 +109,7 @@ class Feature:
         self.dtype = dtype or jnp.asarray(feature_array[:1]).dtype
         self.dedup = bool(dedup)
 
+        self._quant = None               # compressed stores only (from_store)
         self._hot = jnp.asarray(feature_array[: self._hot_count], self.dtype)
         # Host tier; kept as a contiguous numpy view for fast np.take.
         self._cold = np.ascontiguousarray(feature_array[self._hot_count:])
@@ -148,6 +149,13 @@ class Feature:
         ``prefetch_scores`` (e.g. :func:`~glt_tpu.partition.
         frequency_partitioner.residency_scores` over the partition
         book's access statistics) warms the stager's DRAM set.
+
+        A COMPRESSED store (``store.codec`` bf16/int8) keeps compressed
+        bytes in every tier — the HBM hot prefix, the stager's DRAM
+        buffer (whose row budget therefore stretches 2x/4x) and the
+        device transfer — and dequantizes on-chip in the gather
+        epilogue; ``self.dtype`` is then the LOGICAL dtype gathers
+        return (f32), not the wire dtype.
         """
         from ..store.stager import DramStager
 
@@ -156,9 +164,18 @@ class Feature:
         self.split_ratio = float(split_ratio)
         self._hot_count = int(self._n * self.split_ratio)
         hot_np = store.read_rows(np.arange(self._hot_count, dtype=np.int64))
-        self.dtype = dtype or jnp.asarray(np.zeros(1, store.dtype)).dtype
+        spec = store.quant_spec() if hasattr(store, "quant_spec") else None
+        self._quant = spec if (spec is not None and spec.is_compressed) \
+            else None
         self.dedup = bool(dedup)
-        self._hot = jnp.asarray(hot_np, self.dtype)
+        if self._quant is not None:
+            self.dtype = dtype or jnp.asarray(
+                np.zeros(1, np.dtype(self._quant.logical_dtype))).dtype
+            # storage-dtype hot tier (explicit dtype: rows, not ids)
+            self._hot = jnp.asarray(hot_np, hot_np.dtype)
+        else:
+            self.dtype = dtype or jnp.asarray(np.zeros(1, store.dtype)).dtype
+            self._hot = jnp.asarray(hot_np, self.dtype)
         self._cold = None                # no DRAM copy of the cold tier
         self._cold_count = self._n - self._hot_count
         self._cold_np_dtype = store.dtype
@@ -222,16 +239,23 @@ class Feature:
     def _gather_hot_impl(self, hot, id2index, ids):
         from ..ops.dedup_gather import dedup_gather_rows
         from ..ops.gather_pallas import gather_rows
+        from ..store import quant
 
         ids = ids.astype(jnp.int32)
         if self.dedup:
             # unique -> gather uniques -> scatter back (bit-identical).
-            return dedup_gather_rows(hot, ids, id2index=id2index)
+            rows = dedup_gather_rows(hot, ids, id2index=id2index)
+            if self._quant is not None:
+                # Padding rows must be re-zeroed AFTER dequant:
+                # dequantize(0) is the column zero point, not 0.
+                rows = jnp.where((ids >= 0)[:, None],
+                                 quant.dequantize(rows, self._quant), 0)
+            return rows
         valid = ids >= 0
         idx = jnp.where(valid, ids, 0)
         if id2index is not None:
             idx = id2index[idx]
-        rows = gather_rows(hot, idx)
+        rows = gather_rows(hot, idx, dequant=self._quant)
         return jnp.where(valid[:, None], rows, 0)
 
     # -- shape info --------------------------------------------------------
@@ -352,9 +376,10 @@ class Feature:
             return self._gather_tiered_cached(idx, hot_mask, cold_mask)
         cold_pos = np.nonzero(cold_mask)[0]
         # Host moves ONLY the cold rows (was: full-batch np.take of both
-        # tiers + masked merge).
+        # tiers + masked merge).  Hot bytes count at the WIRE width — a
+        # compressed hot tier serves compressed bytes.
         self.bytes_from_hbm += int(hot_mask.sum()) * self._dim \
-            * jnp.dtype(self.dtype).itemsize
+            * jnp.dtype(self._hot.dtype).itemsize
         cold_np = self._fetch_cold(idx[cold_pos] - self._hot_count)
         cap = _pow2_pad(cold_pos.shape[0])
         b = ids_np.shape[0]
@@ -362,20 +387,31 @@ class Feature:
         pos_pad[: cold_pos.shape[0]] = cold_pos
         rows_pad = np.zeros((cap, self._dim), self._cold_np_dtype)
         rows_pad[: cold_pos.shape[0]] = cold_np
+        # Compressed rows cross the host->device wire at storage width
+        # and widen inside the jitted merge; raw rows cast to the target
+        # dtype host-side as before.
+        rows_dev = (jnp.asarray(rows_pad) if self._quant is not None
+                    else jnp.asarray(rows_pad, self.dtype))
         return self._merge_tiered(
             jnp.asarray(np.where(hot_mask, idx, 0), jnp.int32),
-            jnp.asarray(hot_mask), jnp.asarray(pos_pad),
-            jnp.asarray(rows_pad, self.dtype))
+            jnp.asarray(hot_mask), jnp.asarray(pos_pad), rows_dev)
 
     def _merge_tiered(self, idx, hot_mask, cold_pos, cold_rows):
         """Device merge: hot gather at hot slots + cold-row scatter."""
         if self._merge_jit is None:
+            from ..store import quant
+
+            spec = self._quant
+
             @jax.jit
             def merge(hot, idx, hot_mask, cold_pos, cold_rows):
+                if spec is not None:
+                    cold_rows = quant.dequantize(cold_rows, spec)
                 if hot.shape[0]:
-                    out = jnp.where(
-                        hot_mask[:, None],
-                        jnp.take(hot, idx, axis=0, mode="clip"), 0)
+                    rows = jnp.take(hot, idx, axis=0, mode="clip")
+                    if spec is not None:
+                        rows = quant.dequantize(rows, spec)
+                    out = jnp.where(hot_mask[:, None], rows, 0)
                 else:
                     # Fully host-resident (split_ratio == 0, e.g. a
                     # shared-memory attach in a sampling worker).
@@ -403,7 +439,7 @@ class Feature:
         miss_mask = cold_mask & ~hit_np
         miss_pos = np.nonzero(miss_mask)[0]
         self.bytes_from_hbm += int(hot_mask.sum()) * self._dim \
-            * jnp.dtype(self.dtype).itemsize
+            * jnp.dtype(self._hot.dtype).itemsize
         miss_np = self._fetch_cold(idx[miss_pos] - self._hot_count)
         cap = _pow2_pad(miss_pos.shape[0])
         pos_pad = np.full((cap,), b, np.int32)
@@ -412,13 +448,22 @@ class Feature:
         rows_pad[: miss_pos.shape[0]] = miss_np
 
         if self._merge_cached_jit is None:
+            from ..store import quant
+
+            spec = self._quant
+
             @jax.jit
             def merge_cached(cache, hot, idx, hot_mask, rows_c, hit,
                              cold_ids, miss_mask, cold_pos, cold_rows):
+                # The cold cache stores POST-dequant logical rows, so
+                # only the freshly staged misses widen here.
+                if spec is not None:
+                    cold_rows = quant.dequantize(cold_rows, spec)
                 if hot.shape[0]:
-                    out = jnp.where(
-                        hot_mask[:, None],
-                        jnp.take(hot, idx, axis=0, mode="clip"), 0)
+                    rows = jnp.take(hot, idx, axis=0, mode="clip")
+                    if spec is not None:
+                        rows = quant.dequantize(rows, spec)
+                    out = jnp.where(hot_mask[:, None], rows, 0)
                 else:
                     out = jnp.zeros((idx.shape[0], rows_c.shape[1]),
                                     rows_c.dtype)
@@ -438,12 +483,13 @@ class Feature:
 
             self._merge_cached_jit = merge_cached
 
+        rows_dev = (jnp.asarray(rows_pad) if self._quant is not None
+                    else jnp.asarray(rows_pad, self.dtype))
         self._cache, out = self._merge_cached_jit(
             self._cache, self._hot,
             jnp.asarray(np.where(hot_mask, idx, 0), jnp.int32),
             jnp.asarray(hot_mask), rows_c, hit, cold_ids_dev,
-            jnp.asarray(miss_mask), jnp.asarray(pos_pad),
-            jnp.asarray(rows_pad, self.dtype))
+            jnp.asarray(miss_mask), jnp.asarray(pos_pad), rows_dev)
         return out
 
     def __getitem__(self, ids) -> jnp.ndarray:
@@ -465,6 +511,12 @@ class Feature:
             idx = np.asarray(self._id2index)[idx]
         if self._host_full is None:
             rows = self._store.read_rows(np.asarray(idx, np.int64))
+            if self._quant is not None:
+                from ..store import quant
+
+                # Host decode mirrors the device formula; padding rows
+                # re-zero below (decode(0) != 0 for int8).
+                rows = quant.decode(rows, self._quant)
         else:
             rows = self._host_full[idx]
         rows = np.where(valid[:, None], rows, 0)

@@ -137,6 +137,20 @@ EXPLICIT_DIRECTIONS: Dict[str, int] = {
     "cache_capacity_rows": NEUTRAL,
     "epoch_batches": NEUTRAL,
     "scanned_group": NEUTRAL,
+    # Compressed tiers + whole-graph refresh (ISSUE 18,
+    # benchmarks/bench_cold_tier.py, docs/refresh.md): refresh
+    # throughput up-good (the `_per_s` suffix would catch it, pinned
+    # for the table's sake); tier byte counts are workload readings;
+    # staging errors must be zero, so any count tracks DOWN.  The
+    # per-codec effective gather bandwidths (`gather_gb_s_effective_*`,
+    # logical f32 bytes per second) resolve UP via the `_gb_s_` infix.
+    "refresh_nodes_per_s": UP,
+    "refresh_bytes_from_hbm": NEUTRAL,
+    "refresh_bytes_from_dram": NEUTRAL,
+    "refresh_bytes_from_disk": NEUTRAL,
+    "refresh_stage_errors": DOWN,
+    "gather_effective_speedup_bf16": UP,
+    "gather_effective_speedup_int8": UP,
 }
 
 #: ``(suffix, direction)`` checked in order after the explicit table.
@@ -193,6 +207,11 @@ ASPIRATIONS: Dict[str, Tuple[str, float]] = {
     # host-unique DCN slots — flat below that means the per-host dedup
     # is not earning its extra ICI hop.
     "hier_dedup_factor": (">=", 1.5),
+    # Compressed tiers (ISSUE 18): int8 rows move 4x fewer wire bytes,
+    # so the effective (logical-f32) gather bandwidth should reach at
+    # least 2x the raw arm on the same workload — flat below that means
+    # the dequant epilogue is eating the transfer win.
+    "gather_effective_speedup_int8": (">=", 2.0),
 }
 
 #: NEUTRAL-with-ceiling: metrics with no better/worse direction that

@@ -42,12 +42,16 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import tpu_limits
+from ..store import quant
 from .gather_pallas import gather_rows
 from .unique import unique_first_occurrence
 
 _CHUNK = 256
 _LANE = tpu_limits.LANE
 _SUBLANE = tpu_limits.SUBLANE_F32
+# Sublane count of the packed scale/zero/k input block (== quant.
+# SCALE_ZERO_ROWS, padded to the f32 tiling floor for GLT019).
+_SZ_ROWS = 8
 # Unique-block VMEM budget: 3/8 of the core's VMEM (~6 MB of 16) leaves
 # headroom for the output chunk, double-buffered DMA metadata, and
 # whatever the surrounding scanned step keeps live.  Derived from
@@ -177,10 +181,114 @@ def _fused_gather(table, uidx, count, inv, interpret=False,
     return out[:b]
 
 
+def _make_fused_dequant_kernel(up: int, nbuf: int, chunk: int, mode: str):
+    """Fused kernel with a dequantize epilogue in phase B.
+
+    Phase A streams COMPRESSED unique rows into the VMEM buffer (a bf16
+    buffer holds 2x, an int8 buffer 4x the frontier of a raw f32 one —
+    the VMEM gate in :func:`fused_frontier_supported` already counts
+    storage bytes); each phase-B copy widens to f32 through the shared
+    decode formulas of :func:`glt_tpu.store.quant.dequantize` (see the
+    quant module docstring for why affine is add-then-mul).
+    """
+
+    def kernel(uid_ref, nu_ref, inv_ref, table_ref, sz_ref, out_ref,
+               buf, sems):
+        c = pl.program_id(0)
+        scale = sz_ref[0:1, :]
+        zero = sz_ref[1:2, :]
+        kvec = sz_ref[2:3, :]
+
+        @pl.when(c == 0)
+        def _():
+            nu = nu_ref[0]
+
+            def dma(j):
+                return pltpu.make_async_copy(
+                    table_ref.at[pl.ds(uid_ref[j], 1)],
+                    buf.at[pl.ds(j, 1)],
+                    sems.at[lax.rem(j, nbuf)])
+
+            for k in range(nbuf):
+                @pl.when(k < nu)
+                def _():
+                    dma(k).start()
+
+            def fill(j, carry):
+                @pl.when(j < nu)
+                def _():
+                    dma(j).wait()
+
+                @pl.when(j + nbuf < nu)
+                def _():
+                    dma(j + nbuf).start()
+
+                return carry
+
+            lax.fori_loop(0, up, fill, None)
+
+        def copy_row(s, carry):
+            iv = inv_ref[c * chunk + s]
+            row = pl.load(buf, (pl.ds(iv, 1), slice(None)))
+            row = row.astype(jnp.float32)
+            if mode == "affine":
+                row = jnp.where(scale > 0.0, (row + kvec) * scale, zero)
+            pl.store(out_ref, (pl.ds(s, 1), slice(None)), row)
+            return carry
+
+        lax.fori_loop(0, chunk, copy_row, None)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "mode",
+                                             "ring_depth"))
+def _fused_gather_dq(table, sz, uidx, count, inv, interpret=False,
+                     mode="widen", ring_depth=_RING):
+    """Dequantizing twin of :func:`_fused_gather`: compressed ``table``
+    in, f32 rows out.  ``sz`` is the ``[_SZ_ROWS, d]`` f32
+    scale/zero/k block."""
+    b = inv.shape[0]
+    d = table.shape[1]
+    n = table.shape[0]
+    up = -(-b // _SUBLANE) * _SUBLANE
+    bp = -(-b // _CHUNK) * _CHUNK
+    uid_p = jnp.concatenate(
+        [jnp.clip(uidx.astype(jnp.int32), 0, n - 1),
+         jnp.zeros((up - b,), jnp.int32)])
+    inv_p = jnp.concatenate(
+        [jnp.clip(inv.astype(jnp.int32), 0, up - 1),
+         jnp.zeros((bp - b,), jnp.int32)])
+    nu = jnp.asarray(count, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(bp // _CHUNK,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((_SZ_ROWS, d), lambda c, *_: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_CHUNK, d), lambda c, *_: (c, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((up, d), table.dtype),
+            pltpu.SemaphoreType.DMA((ring_depth,)),
+        ],
+    )
+    out = pl.pallas_call(
+        _make_fused_dequant_kernel(up, ring_depth, _CHUNK, mode),
+        out_shape=jax.ShapeDtypeStruct((bp, d), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(uid_p, nu, inv_p, table, sz)
+    return out[:b]
+
+
 def fused_frontier(table: jnp.ndarray, ids: jnp.ndarray,
                    id2index: Optional[jnp.ndarray] = None,
                    force: str = "auto",
-                   vmem_budget: Optional[int] = None) -> FusedFrontier:
+                   vmem_budget: Optional[int] = None,
+                   dequant=None) -> FusedFrontier:
     """Dedup + gather a frontier in one dispatch.
 
     Bit-identical to running :func:`unique_first_occurrence` +
@@ -196,6 +304,13 @@ def fused_frontier(table: jnp.ndarray, ids: jnp.ndarray,
         kernel in Pallas interpret mode (CPU tests); 'pallas'/'interpret'
         still fall back to XLA when the frontier exceeds the VMEM budget.
       vmem_budget: unique-block byte budget (default ~6 MB).
+      dequant: optional :class:`~glt_tpu.store.quant.QuantSpec` for a
+        compressed ``table``.  The fused kernel buffers compressed
+        unique rows (2x/4x frontier capacity under the same VMEM gate)
+        and widens to f32 in the phase-B epilogue; the fallback
+        dequantizes post-gather with the identical formula, so both
+        arms still agree bit-for-bit.  Padding rows are zeroed AFTER
+        dequantization (``dequantize(0) != 0`` for int8).
     """
     env = os.environ.get("GLT_FUSED_FORCE")
     if env in ("pallas", "xla", "interpret"):
@@ -208,15 +323,25 @@ def fused_frontier(table: jnp.ndarray, ids: jnp.ndarray,
         uidx = jnp.take(id2index, uidx, axis=0, mode="clip")
     use = (force in ("pallas", "interpret")
            or (force == "auto" and jax.default_backend() == "tpu"))
+    compressed = dequant is not None and dequant.is_compressed
     if use and fused_frontier_supported(table, ids, vmem_budget):
-        rows = _fused_gather(table, uidx, cnt, inv,
-                             interpret=(force == "interpret"))
+        if compressed:
+            mode = "affine" if dequant.codec == "int8" else "widen"
+            sz = jnp.asarray(
+                quant.scale_zero_rows(dequant, int(table.shape[1])))
+            rows = _fused_gather_dq(table, sz, uidx, cnt, inv,
+                                    interpret=(force == "interpret"),
+                                    mode=mode)
+        else:
+            rows = _fused_gather(table, uidx, cnt, inv,
+                                 interpret=(force == "interpret"))
         x = jnp.where((inv >= 0)[:, None], rows, 0)
     else:
         # Unfused fallback — dedup_gather_rows verbatim (two HBM passes,
         # same bits).  inv only references valid unique slots (< cnt),
         # so both paths read identical source rows.
-        urows = jnp.where(uvalid[:, None], gather_rows(table, uidx), 0)
+        urows = gather_rows(table, uidx, dequant=dequant)
+        urows = jnp.where(uvalid[:, None], urows, 0)
         rows = jnp.take(urows, jnp.clip(inv, 0, inv.shape[0] - 1), axis=0)
         x = jnp.where((inv >= 0)[:, None], rows, 0)
     return FusedFrontier(unique_ids=uniq, inverse=inv, features=x)

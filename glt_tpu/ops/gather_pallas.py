@@ -59,10 +59,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import tpu_limits
+from ..store import quant
 
 _CHUNK = 256  # output rows per grid step (batch padded to a multiple)
 _LANE = tpu_limits.LANE
 _MIN_TILE = tpu_limits.SUBLANE_F32
+# Sublane count of the packed scale/zero input block (== quant.
+# SCALE_ZERO_ROWS): row 0 = scale, row 1 = zero, padded to the f32
+# tiling floor so the block satisfies GLT019.
+_SZ_ROWS = 8
 
 # The (tile_rows, ring_depth) grid the autotuner sweeps — and the grid
 # the static VMEM model (analysis/kernelmodel.py GLT017) verifies every
@@ -251,6 +256,168 @@ def _gather_sorted_pallas(table, idx_p, interpret, tile_rows, ring_depth):
     return jnp.take(sorted_out, inv, axis=0)
 
 
+def _make_tiled_dequant_kernel(tile: int, nbuf: int, mode: str):
+    """The tiled gather kernel with a dequantize epilogue on copy-out.
+
+    Identical DMA structure to :func:`_make_tiled_kernel` — compressed
+    table rows stream HBM->VMEM at their narrow storage width and widen
+    to f32 only as each row is copied to the output block, so the DMA
+    ring moves 2x (bf16) / 4x (int8) fewer bytes than a raw f32 gather.
+
+    ``mode`` is static: ``"widen"`` is a plain f32 astype (bf16 —
+    deliberately NOT ``x * 1 + 0``, which would flip ``-0.0``);
+    ``"affine"`` applies the per-column ``(x + k) * scale`` /
+    constant-column select from the ``sz`` input block (row 0 = scale,
+    row 1 = zero, row 2 = k).  The formulas mirror :func:`glt_tpu.
+    store.quant.dequantize` exactly — add-then-mul is
+    contraction-proof (quant module docstring), so the XLA arm of the
+    seam agrees bit-for-bit.
+    """
+
+    def kernel(dstart_ref, row_lo_ref, row_hi_ref, ndma_ref, off_ref,
+               table_ref, sz_ref, out_ref, tiles, sems):
+        c = pl.program_id(0)
+        nd = ndma_ref[c]
+        scale = sz_ref[0:1, :]
+        zero = sz_ref[1:2, :]
+        kvec = sz_ref[2:3, :]
+
+        def dma(j):
+            slot = lax.rem(j, nbuf)
+            start = dstart_ref[c, j]
+            return pltpu.make_async_copy(
+                table_ref.at[pl.ds(start, tile)], tiles.at[slot],
+                sems.at[slot])
+
+        for k in range(nbuf):
+            @pl.when(k < nd)
+            def _():
+                dma(k).start()
+
+        def body(j, _):
+            slot = lax.rem(j, nbuf)
+            dma(j).wait()
+            lo = row_lo_ref[c, j]
+            hi = row_hi_ref[c, j]
+
+            def copy_row(s, _):
+                o = off_ref[c * _CHUNK + s]
+                row = pl.load(tiles, (slot, pl.ds(o, 1), slice(None)))
+                row = row.astype(jnp.float32)
+                if mode == "affine":
+                    row = jnp.where(scale > 0.0, (row + kvec) * scale,
+                                    zero)
+                pl.store(out_ref, (pl.ds(s, 1), slice(None)), row)
+                return _
+
+            lax.fori_loop(lo, hi, copy_row, None)
+            @pl.when(j + nbuf < nd)
+            def _():
+                dma(j + nbuf).start()
+            return _
+
+        lax.fori_loop(0, nd, body, None)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "tile_rows",
+                                             "ring_depth", "mode"))
+def _gather_sorted_pallas_dq(table, sz, idx_p, interpret, tile_rows,
+                             ring_depth, mode):
+    """Dequantizing twin of :func:`_gather_sorted_pallas`: compressed
+    ``table`` in, f32 rows out.  ``sz`` is the ``[_SZ_ROWS, d]`` f32
+    scale/zero block (:func:`glt_tpu.store.quant.scale_zero_rows`)."""
+    bp = idx_p.shape[0]
+    n, d = table.shape
+    order, dstart, row_lo, row_hi, ndma, off = _plan_tiled(
+        idx_p, n, tile_rows)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(bp // _CHUNK,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((_SZ_ROWS, d), lambda c, *_: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_CHUNK, d), lambda c, *_: (c, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((ring_depth, tile_rows, d), table.dtype),
+            pltpu.SemaphoreType.DMA((ring_depth,)),
+        ],
+    )
+    sorted_out = pl.pallas_call(
+        _make_tiled_dequant_kernel(tile_rows, ring_depth, mode),
+        out_shape=jax.ShapeDtypeStruct((bp, d), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(dstart, row_lo, row_hi, ndma, off, table, sz)
+
+    inv = (jnp.zeros((bp,), jnp.int32)
+           .at[order].set(jnp.arange(bp, dtype=jnp.int32)))
+    return jnp.take(sorted_out, inv, axis=0)
+
+
+def gather_rows_pallas_dq(table: jnp.ndarray, idx: jnp.ndarray,
+                          spec, interpret: bool = False,
+                          tile_rows: int = None,
+                          ring_depth: int = None) -> jnp.ndarray:
+    """Gather compressed ``table[idx]`` and dequantize on-chip to f32.
+
+    Same shape contract as :func:`gather_rows_pallas`; ``spec`` is the
+    store's :class:`~glt_tpu.store.quant.QuantSpec`.  int8 tables obey
+    the 32-sublane tiling floor through the same
+    :func:`candidate_gather_params` pruning as any 1-byte dtype.
+    """
+    b = idx.shape[0]
+    n, d = table.shape
+    mode = "affine" if spec.codec == "int8" else "widen"
+    if tile_rows is None or ring_depth is None:
+        dt, dr = default_gather_params(d if d % _LANE == 0 else 128,
+                                       table.dtype)
+        if tile_rows is None:
+            rows = n if d % _LANE == 0 else n // 2
+            lo = _sublane_min(table.dtype)
+            tile_rows = max(lo, min(dt, (rows // lo) * lo))
+        if ring_depth is None:
+            ring_depth = dr
+    bp = -(-b // _CHUNK) * _CHUNK
+    idx_p = jnp.concatenate(
+        [idx.astype(jnp.int32), jnp.zeros((bp - b,), jnp.int32)])
+
+    if d % _LANE == 0:
+        if n < tile_rows:
+            raise ValueError(f"table rows {n} must be >= {tile_rows}")
+        sz = jnp.asarray(quant.scale_zero_rows(spec, d))
+        out = _gather_sorted_pallas_dq(table, sz, idx_p, interpret,
+                                       tile_rows, ring_depth, mode)
+        return out[:b]
+    if d == 64:
+        # Paired-row view, as in gather_rows_pallas.  Column j of the
+        # original table lands in lanes j AND 64 + j of the paired
+        # view, so scale/zero are tiled twice along lanes; dequant runs
+        # on the full 128-lane row BEFORE the half-select (the same
+        # per-element formula either side of the select).
+        if n % 2 != 0:
+            raise ValueError(f"d=64 path needs an even row count, got {n}")
+        if n // 2 < tile_rows:
+            raise ValueError(
+                f"paired table rows {n // 2} must be >= {tile_rows}")
+        idx_c = jnp.clip(idx_p, 0, n - 1)
+        sz64 = quant.scale_zero_rows(spec, 64)
+        sz = jnp.asarray(
+            jnp.concatenate([jnp.asarray(sz64), jnp.asarray(sz64)], axis=1))
+        paired = _gather_sorted_pallas_dq(
+            table.reshape(n // 2, _LANE), sz, idx_c // 2, interpret,
+            tile_rows, ring_depth, mode)
+        half = jnp.take_along_axis(
+            paired.reshape(bp, 2, 64),
+            (idx_c % 2)[:, None, None], axis=1)[:, 0]
+        return half[:b]
+    raise ValueError(f"dim {d} must be a multiple of 128 (or exactly 64)")
+
+
 def gather_rows_pallas(table: jnp.ndarray, idx: jnp.ndarray,
                        interpret: bool = False,
                        tile_rows: int = None,
@@ -422,17 +589,35 @@ def reset_autotune() -> None:
 
 
 def gather_rows(table: jnp.ndarray, idx: jnp.ndarray,
-                force: str = "auto") -> jnp.ndarray:
+                force: str = "auto", dequant=None) -> jnp.ndarray:
     """Gather rows, choosing the best implementation.
 
     force: 'auto' | 'pallas' | 'xla'.  'auto' reads the decision table
     filled by :func:`autotune_gather_rows` (XLA until a measurement
     exists) and runs the winning (tile_rows, ring_depth) point.  The
     ``GLT_GATHER_FORCE`` env var overrides ``force``.
+
+    dequant: optional :class:`~glt_tpu.store.quant.QuantSpec` for a
+    compressed ``table``.  The Pallas arm widens rows to f32 in the
+    copy-out epilogue (compressed bytes over the DMA ring); the XLA arm
+    gathers compressed rows and dequantizes post-gather with the
+    identical formula, so both arms agree bit-for-bit.  ``dequant=None``
+    (or a raw spec) is byte-for-byte the pre-codec path.
     """
     env = os.environ.get("GLT_GATHER_FORCE")
     if env in ("pallas", "xla"):
         force = env
+    if dequant is not None and dequant.is_compressed:
+        if force == "pallas" or (force == "auto"
+                                 and _AUTO.get(_auto_key(table, idx))
+                                 is not None):
+            params = _AUTO.get(_auto_key(table, idx))
+            if params is not None:
+                return gather_rows_pallas_dq(table, idx, dequant,
+                                             tile_rows=params[0],
+                                             ring_depth=params[1])
+            return gather_rows_pallas_dq(table, idx, dequant)
+        return quant.dequantize(_xla_gather(table, idx), dequant)
     if force == "pallas":
         params = _AUTO.get(_auto_key(table, idx))
         if params is not None:

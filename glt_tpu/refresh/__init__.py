@@ -1,0 +1,21 @@
+"""glt_tpu.refresh — layer-wise whole-graph embedding refresh.
+
+Full-graph inference layer by layer (docs/refresh.md): layer ``l``
+sweeps every node partition once, gathers the previous layer's
+embeddings for the partition plus its 1-hop frontier through the
+tiered :class:`~glt_tpu.data.feature.Feature` (HBM / DRAM stager /
+disk), applies one GNN layer on device, and streams the partition's
+rows into a :class:`~glt_tpu.store.disk.FeatureStoreWriter` that
+atomically publishes ``layer_{l}`` when the sweep set completes.  Each
+node is touched exactly once per layer, so the working set is one
+partition's frontier — never ``O(fanout^L)`` and never the full
+``[N, d]`` matrix.
+
+Sweep boundaries are the checkpoint unit: :class:`RefreshDriver`
+implements the PR-8 ``state_dict`` protocol and resumes bit-identically
+(disjoint sweeps + pure row encoding make partial-output rewrites
+idempotent).
+"""
+from .driver import RefreshDriver, RefreshReport, sage_refresh_layers
+
+__all__ = ["RefreshDriver", "RefreshReport", "sage_refresh_layers"]

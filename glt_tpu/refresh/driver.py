@@ -1,0 +1,328 @@
+"""Layer-wise whole-graph embedding refresh driver.
+
+The reference engine refreshes whole-graph embeddings between training
+rounds by running inference layer by layer: instead of sampling a
+multi-hop subgraph per seed (fanout blow-up, every node recomputed
+once per seed that reaches it), layer ``l`` is computed for *all* nodes
+before layer ``l+1`` starts, so each node is touched exactly once per
+layer and the per-step working set is one node partition plus its
+1-hop frontier.
+
+Data path per sweep (one partition of ``block_size`` nodes):
+
+1. host builds the frontier: the partition's nodes first, then the
+   sorted set of their CSR neighbors not already in the partition,
+   -1-padded to the static cap ``block_size * (max_degree + 1)``;
+2. the *next* sweep's frontier is handed to
+   :meth:`~glt_tpu.data.feature.Feature.stage_ahead` so the DRAM
+   stager fills ahead of the gather (the block-ahead prefetch oracle);
+3. ``feature.gather`` pulls the frontier rows through the HBM / DRAM /
+   disk tiers (compressed stores dequantize on-chip in the gather
+   epilogue);
+4. a jitted step under ``compilewatch.label("refresh_sweep_{l}")``
+   expands the frontier's induced edges with
+   :func:`~glt_tpu.ops.subgraph.node_subgraph` and applies one layer —
+   messages flow neighbor → owner, so rows ``[:block_len]`` (the
+   partition, by frontier construction) are exact layer-``l`` outputs;
+5. the partition's rows stream into a
+   :class:`~glt_tpu.store.disk.FeatureStoreWriter`; finalize publishes
+   ``workdir/layer_{l}`` atomically and the next layer reads it back
+   through a fresh tiered ``Feature``.
+
+Sweeps cover disjoint row ranges and row encoding is a pure function,
+so resuming from a sweep-boundary checkpoint and rewriting a range is
+bit-identical to an uninterrupted run (the writer re-attaches to its
+deterministic partial file; the final sha256 matches).
+
+Nodes whose degree exceeds ``max_degree`` are truncated to their first
+``max_degree`` CSR neighbors — the same static-shape cap the sampling
+paths use; size it to the graph's max degree for exact refresh.
+"""
+from __future__ import annotations
+
+import math
+import os
+import time
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import compilewatch
+from ..obs import metrics as _metrics
+from ..ops.subgraph import node_subgraph
+from ..store.disk import DiskFeatureStore, FeatureStoreWriter
+
+LayerFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+class RefreshReport(dict):
+    """``run()`` summary: plain dict with attribute sugar."""
+
+    __getattr__ = dict.__getitem__
+
+
+def sage_refresh_layers(model, params) -> List[LayerFn]:
+    """Split a :class:`~glt_tpu.models.sage.GraphSAGE` into per-layer
+    inference callables ``fn(x, edge_index, edge_mask) -> h``.
+
+    Matches the model's ``train=False`` forward exactly: ``conv{i}``
+    then ReLU on every non-last layer (dropout is identity at
+    inference).  Each callable closes over its own parameter subtree so
+    the driver never materializes unused layers' weights on device
+    together.
+    """
+    import flax.linen as nn
+
+    from ..models.conv import SAGEConv
+
+    tree = params["params"] if "params" in params else params
+    fns: List[LayerFn] = []
+    for i in range(model.num_layers):
+        last = i == model.num_layers - 1
+        dim = model.out_features if last else model.hidden_features
+        conv = SAGEConv(dim, dtype=model.dtype)
+        layer_params = tree[f"conv{i}"]
+
+        def fn(x, edge_index, edge_mask, *, _conv=conv, _p=layer_params,
+               _last=last):
+            h = _conv.apply({"params": _p}, x, edge_index, edge_mask)
+            return h if _last else nn.relu(h)
+
+        fns.append(fn)
+    return fns
+
+
+class RefreshDriver:
+    """Drive a layer-wise whole-graph refresh over a tiered store.
+
+    Parameters
+    ----------
+    indptr, indices:
+        Whole-graph CSR (host numpy; pushed to device once).
+    layer_fns:
+        One inference callable per layer, ``fn(x, edge_index,
+        edge_mask) -> h`` (see :func:`sage_refresh_layers`).
+    store:
+        Layer-0 input :class:`~glt_tpu.store.disk.DiskFeatureStore`
+        (any codec — compressed rows dequantize on-chip).
+    workdir:
+        Output directory; layer ``l`` publishes to
+        ``workdir/layer_{l}``.
+    out_codec:
+        Codec for the published embedding stores — ``raw`` or ``bf16``
+        (``int8`` needs whole-matrix calibration a streaming writer
+        cannot do).
+    checkpointer:
+        Optional :class:`~glt_tpu.ckpt.driver.Checkpointer`; the driver
+        registers itself as the ``"refresh"`` component and saves at
+        sweep boundaries (step = ``layer * num_sweeps + sweep + 1``).
+    on_sweep:
+        Optional ``hook(driver, layer, sweep)`` called after each sweep
+        is durably written (tests use it to simulate preemption).
+    """
+
+    def __init__(self, indptr, indices, layer_fns: Sequence[LayerFn],
+                 store: DiskFeatureStore, workdir: str, *,
+                 block_size: int = 256, max_degree: int = 32,
+                 out_codec: str = "raw",
+                 dram_budget_bytes: int = 64 << 20,
+                 split_ratio: float = 0.0, stage_threads: int = 1,
+                 checkpointer=None,
+                 on_sweep: Optional[Callable] = None):
+        if out_codec not in ("raw", "bf16"):
+            raise ValueError(
+                f"refresh out_codec must be raw|bf16, got {out_codec!r}")
+        self._indptr_np = np.asarray(indptr, np.int64)
+        self._indices_np = np.asarray(indices, np.int64)
+        self._indptr = jnp.asarray(self._indptr_np, jnp.int32)
+        self._indices = jnp.asarray(self._indices_np, jnp.int32)
+        self.num_nodes = int(self._indptr_np.shape[0] - 1)
+        if store.num_rows != self.num_nodes:
+            raise ValueError(
+                f"store has {store.num_rows} rows but CSR has "
+                f"{self.num_nodes} nodes")
+        self.layer_fns = list(layer_fns)
+        self.store = store
+        self.workdir = os.path.abspath(workdir)
+        self.block_size = int(block_size)
+        self.max_degree = int(max_degree)
+        self.out_codec = out_codec
+        self.dram_budget_bytes = int(dram_budget_bytes)
+        self.split_ratio = float(split_ratio)
+        self.stage_threads = int(stage_threads)
+        self.checkpointer = checkpointer
+        self.on_sweep = on_sweep
+        self.num_sweeps = max(
+            1, math.ceil(self.num_nodes / self.block_size))
+        self.frontier_cap = self.block_size * (self.max_degree + 1)
+        # Resume cursor: the next (layer, sweep) to run.
+        self._layer = 0
+        self._sweep = 0
+        self.totals = {"nodes": 0, "seconds": 0.0, "bytes_from_hbm": 0,
+                       "bytes_from_dram": 0, "bytes_from_disk": 0,
+                       "stage_errors": 0, "hits": 0, "misses": 0}
+
+    # -- PR-8 checkpoint protocol ------------------------------------
+    def state_dict(self) -> dict:
+        return {"layer": self._layer, "sweep": self._sweep}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._layer = int(state["layer"])
+        self._sweep = int(state["sweep"])
+
+    # -- host-side frontier construction -----------------------------
+    def _frontier(self, sweep: int):
+        """Partition nodes first, then their sorted out-of-partition
+        CSR neighbors, -1-padded to the static ``frontier_cap``."""
+        lo = sweep * self.block_size
+        hi = min(self.num_nodes, lo + self.block_size)
+        nodes = np.arange(lo, hi, dtype=np.int32)
+        start = self._indptr_np[nodes]
+        deg = np.minimum(self._indptr_np[nodes + 1] - start,
+                         self.max_degree)
+        offs = np.arange(self.max_degree, dtype=np.int64)[None, :]
+        valid = offs < deg[:, None]
+        flat = start[:, None] + np.where(valid, offs, 0)
+        nbrs = self._indices_np[flat][valid]
+        ext = np.setdiff1d(np.unique(nbrs), nodes).astype(np.int32)
+        frontier = np.full(self.frontier_cap, -1, np.int32)
+        frontier[: nodes.size] = nodes
+        frontier[nodes.size: nodes.size + ext.size] = ext
+        return frontier, int(nodes.size), int(lo)
+
+    # -- device step --------------------------------------------------
+    def _build_step(self, layer_fn: LayerFn):
+        indptr, indices = self._indptr, self._indices
+        max_degree = self.max_degree
+
+        @jax.jit
+        def step(x, frontier):
+            sub = node_subgraph(indptr, indices, frontier, max_degree)
+            # CSR rows own their neighbor lists; messages flow
+            # neighbor -> owner, so src = cols, dst = rows.
+            edge_index = jnp.stack([sub.cols, sub.rows])
+            return layer_fn(x, edge_index, sub.mask)
+
+        return step
+
+    def _out_dim(self, layer_fn: LayerFn, in_dim: int) -> int:
+        shapes = (jax.ShapeDtypeStruct((1, in_dim), jnp.float32),
+                  jax.ShapeDtypeStruct((2, 1), jnp.int32),
+                  jax.ShapeDtypeStruct((1,), jnp.bool_))
+        return int(jax.eval_shape(layer_fn, *shapes).shape[-1])
+
+    def _layer_root(self, layer: int) -> str:
+        return os.path.join(self.workdir, f"layer_{layer}")
+
+    # -- main loop -----------------------------------------------------
+    def run(self) -> RefreshReport:
+        """Refresh every layer; returns a summary report.
+
+        With a ``checkpointer``, first resumes the latest snapshot and
+        skips already-completed (layer, sweep) work; the re-attached
+        partial writer makes the final stores bit-identical to an
+        uninterrupted run.
+        """
+        if self.checkpointer is not None:
+            self.checkpointer.resume({"refresh": self})
+        os.makedirs(self.workdir, exist_ok=True)
+        nodes_per_s = _metrics.gauge(
+            "glt.refresh.nodes_per_s",
+            "whole-graph refresh throughput (nodes/sec, last sweep)")
+        sweep_ms = _metrics.histogram(
+            "glt.refresh.sweep_ms", "per-sweep wall time (ms)")
+        tier_counters = {
+            k: _metrics.counter(
+                f"glt.refresh.bytes_from_{k}",
+                f"refresh gather bytes served from the {k} tier")
+            for k in ("hbm", "dram", "disk")
+        }
+
+        from ..data.feature import Feature
+
+        start_layer = self._layer
+        for layer in range(start_layer, len(self.layer_fns)):
+            layer_fn = self.layer_fns[layer]
+            src = (self.store if layer == 0
+                   else DiskFeatureStore(self._layer_root(layer - 1)))
+            feature = Feature.from_store(
+                src, self.dram_budget_bytes,
+                split_ratio=self.split_ratio,
+                stage_threads=self.stage_threads)
+            out_dim = self._out_dim(layer_fn, src.dim)
+            writer = FeatureStoreWriter(
+                self._layer_root(layer), self.num_nodes, out_dim,
+                logical_dtype=np.float32, codec=self.out_codec,
+                overwrite=True)
+            step_fn = self._build_step(layer_fn)
+            label = f"refresh_sweep_{layer}"
+            try:
+                first = self._sweep if layer == self._layer else 0
+                if first > 0 and not writer.reattached:
+                    # The checkpoint says sweeps [0, first) are done but
+                    # their partial output did not survive — earlier
+                    # rows would publish as zeros.  Sweeps are
+                    # idempotent, so just redo the layer.
+                    first = 0
+                nxt = self._frontier(first) if first < self.num_sweeps \
+                    else None
+                for sweep in range(first, self.num_sweeps):
+                    frontier_np, block_len, lo = nxt
+                    if sweep + 1 < self.num_sweeps:
+                        nxt = self._frontier(sweep + 1)
+                        feature.stage_ahead(nxt[0])
+                    else:
+                        nxt = None
+                    stats0 = feature.store_stats() or {}
+                    t0 = time.perf_counter()
+                    frontier = jnp.asarray(frontier_np)
+                    x = feature.gather(frontier)
+                    with compilewatch.label(label):
+                        h = step_fn(x, frontier)
+                    writer.write_rows(
+                        lo, np.asarray(h[:block_len], np.float32))
+                    dt = time.perf_counter() - t0
+                    stats1 = feature.store_stats() or {}
+                    nodes_per_s.set(block_len / max(dt, 1e-9))
+                    sweep_ms.observe(dt * 1e3)
+                    for k, c in tier_counters.items():
+                        c.inc(stats1.get(f"bytes_from_{k}", 0)
+                              - stats0.get(f"bytes_from_{k}", 0))
+                        self.totals[f"bytes_from_{k}"] += (
+                            stats1.get(f"bytes_from_{k}", 0)
+                            - stats0.get(f"bytes_from_{k}", 0))
+                    self.totals["nodes"] += block_len
+                    self.totals["seconds"] += dt
+                    self._layer, self._sweep = layer, sweep + 1
+                    ckpt = self.checkpointer
+                    if ckpt is not None:
+                        step_no = layer * self.num_sweeps + sweep + 1
+                        if ckpt.due(step_no):
+                            writer.flush()
+                            ckpt.save(step_no, {"refresh": self})
+                    if self.on_sweep is not None:
+                        self.on_sweep(self, layer, sweep)
+                end_stats = feature.store_stats() or {}
+                for k in ("stage_errors", "hits", "misses"):
+                    self.totals[k] += end_stats.get(k, 0)
+            except BaseException:
+                feature.close()
+                raise
+            feature.close()
+            writer.finalize()
+            self._layer, self._sweep = layer + 1, 0
+        secs = self.totals["seconds"]
+        lookups = self.totals["hits"] + self.totals["misses"]
+        return RefreshReport(
+            out_root=self._layer_root(len(self.layer_fns) - 1),
+            layers=len(self.layer_fns), num_sweeps=self.num_sweeps,
+            nodes=self.totals["nodes"],
+            nodes_per_s=self.totals["nodes"] / secs if secs else 0.0,
+            bytes_from_hbm=self.totals["bytes_from_hbm"],
+            bytes_from_dram=self.totals["bytes_from_dram"],
+            bytes_from_disk=self.totals["bytes_from_disk"],
+            stage_errors=self.totals["stage_errors"],
+            dram_hit_rate=(self.totals["hits"] / lookups if lookups
+                           else 0.0))

@@ -14,26 +14,35 @@ The storage stack below the HBM hot tier and the host-DRAM cold tier
   disk tier under :class:`~glt_tpu.parallel.dist_train.
   TieredTrainPipeline` and the fused scanned epoch unchanged;
 * :func:`publish_store_stats` — ``glt.store.*`` gauges through the obs
-  registry.
+  registry;
+* :mod:`~glt_tpu.store.quant` — the bf16/int8 row codecs: compressed
+  bytes flow through every tier and widen to f32 on-chip in the gather
+  epilogues (docs/storage.md "Compressed tiers").
 """
 from .disk import (
     DATA_NAME,
     FORMAT_VERSION,
     MANIFEST_NAME,
     DiskFeatureStore,
+    FeatureStoreWriter,
     StoreCorruptError,
     StoreError,
     write_feature_store,
 )
+from .quant import CODECS, QuantSpec, dequantize
 from .stager import DiskColdStore, DramStager, publish_store_stats
 
 __all__ = [
+    "CODECS",
     "DATA_NAME",
     "FORMAT_VERSION",
     "MANIFEST_NAME",
     "DiskFeatureStore",
+    "FeatureStoreWriter",
+    "QuantSpec",
     "StoreCorruptError",
     "StoreError",
+    "dequantize",
     "write_feature_store",
     "DiskColdStore",
     "DramStager",

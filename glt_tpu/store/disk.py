@@ -36,10 +36,13 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import shutil
 import threading
 from typing import Optional
 
 import numpy as np
+
+from glt_tpu.store import quant
 
 FORMAT_VERSION = 1
 DATA_NAME = "features.bin"
@@ -78,12 +81,26 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
-def write_feature_store(root: str, array: np.ndarray) -> str:
+def write_feature_store(root: str, array: np.ndarray, codec: str = "raw",
+                        overwrite: bool = False) -> str:
     """Write ``array`` (``[N, d]``) as a feature store directory at ``root``.
 
     Atomic publish (GLT011): everything lands under ``.tmp-<pid>`` next
     to ``root`` and ONE ``os.replace`` makes it visible.  Returns
     ``root``.
+
+    Args:
+      codec: row encoding — ``"raw"`` stores ``array`` bit-exactly;
+        ``"bf16"``/``"int8"`` compress through :mod:`glt_tpu.store.
+        quant` (manifest records the codec and, for int8, the
+        per-column scale/zero).  The manifest ``dtype`` is always the
+        LOGICAL dtype readers decode to.
+      overwrite: with an existing ``root``, ``False`` (the default)
+        refuses; ``True`` publishes over it atomically — the new tree
+        is fully written under ``.tmp-*``, the old root is moved aside
+        to a ``.trash-*`` sibling, the tmp is renamed in, and the trash
+        is deleted.  Readers see either the complete old store or the
+        complete new one, never a mix.
     """
     array = np.asarray(array)
     if array.ndim == 1:
@@ -92,20 +109,24 @@ def write_feature_store(root: str, array: np.ndarray) -> str:
         raise StoreError(
             f"feature store rows must be [N, d]; got shape {array.shape}")
     root = os.path.abspath(root)
-    if os.path.exists(root):
+    if os.path.exists(root) and not overwrite:
         raise StoreError(f"feature store target already exists: {root}")
+    encoded, spec = quant.encode(array, codec)
     parent = os.path.dirname(root) or "."
     os.makedirs(parent, exist_ok=True)
     tmp = os.path.join(parent, f".tmp-{os.path.basename(root)}-{os.getpid()}")
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
     os.makedirs(tmp)
     data_path = os.path.join(tmp, DATA_NAME)
-    np.ascontiguousarray(array).tofile(data_path)
+    np.ascontiguousarray(encoded).tofile(data_path)
     manifest = {
         "format_version": FORMAT_VERSION,
-        "dtype": np.dtype(array.dtype).str,
+        "dtype": np.dtype(spec.logical_dtype).str,
         "shape": [int(array.shape[0]), int(array.shape[1])],
         "sha256": _sha256(data_path),
     }
+    manifest.update(quant.spec_to_manifest(spec))
     with open(os.path.join(tmp, MANIFEST_NAME), "w") as fh:
         json.dump(manifest, fh)
         fh.flush()
@@ -113,7 +134,14 @@ def write_feature_store(root: str, array: np.ndarray) -> str:
     with open(data_path, "rb") as fh:
         os.fsync(fh.fileno())
     _fsync_dir(tmp)
-    os.replace(tmp, root)
+    if os.path.exists(root):
+        trash = os.path.join(
+            parent, f".trash-{os.path.basename(root)}-{os.getpid()}")
+        os.replace(root, trash)
+        os.replace(tmp, root)
+        shutil.rmtree(trash, ignore_errors=True)
+    else:
+        os.replace(tmp, root)
     _fsync_dir(parent)
     return root
 
@@ -147,10 +175,26 @@ class DiskFeatureStore:
             raise StoreError(
                 f"store format {man.get('format_version')!r} != "
                 f"{FORMAT_VERSION} at {self.root}")
-        self.dtype = np.dtype(man["dtype"])
+        # ``dtype`` is the STORAGE dtype (what features.bin holds and
+        # what flows through memmap reads, stager buffers and device
+        # transfers); ``logical_dtype`` is what rows decode to.  For a
+        # raw store the two coincide and nothing changes.
+        self.codec = man.get("codec", "raw")
+        self.logical_dtype = np.dtype(man["dtype"])
+        try:
+            self.dtype = quant.storage_dtype(self.codec, self.logical_dtype)
+        except ValueError as e:
+            raise StoreError(f"bad store manifest {mpath}: {e}")
+        self._quant_spec = quant.spec_from_manifest(man)
         shape = man["shape"]
         self.num_rows, self.dim = int(shape[0]), int(shape[1])
         self.row_nbytes = self.dim * self.dtype.itemsize
+        if (self.codec == "int8"
+                and len(np.asarray(self._quant_spec.scale)) != self.dim):
+            raise StoreError(
+                f"int8 store manifest {mpath} carries "
+                f"{len(np.asarray(self._quant_spec.scale))} scale entries "
+                f"for dim {self.dim}")
         self.sha256 = man["sha256"]
         self._data_path = os.path.join(self.root, DATA_NAME)
         expected = self.num_rows * self.row_nbytes
@@ -180,6 +224,14 @@ class DiskFeatureStore:
     @property
     def shape(self):
         return (self.num_rows, self.dim)
+
+    @property
+    def is_compressed(self) -> bool:
+        return self.codec != "raw"
+
+    def quant_spec(self) -> "quant.QuantSpec":
+        """The :class:`~glt_tpu.store.quant.QuantSpec` decoding this store."""
+        return self._quant_spec
 
     def _mapped(self) -> np.ndarray:
         """The read-only memmap view, created lazily (one per store)."""
@@ -235,4 +287,124 @@ class DiskFeatureStore:
 
     def __repr__(self) -> str:
         return (f"DiskFeatureStore(shape={self.shape}, dtype={self.dtype}, "
-                f"root={self.root!r})")
+                f"codec={self.codec!r}, root={self.root!r})")
+
+
+class FeatureStoreWriter:
+    """Streaming range writer for a feature store: sweeps land in place,
+    :meth:`finalize` checksums and atomically publishes.
+
+    The refresh driver writes one node partition at a time, so the full
+    ``[N, d]`` output never materializes in memory: rows land directly
+    in a memmapped data file under a DETERMINISTIC ``.partial-<name>``
+    sibling of ``root`` (no pid — a restarted writer re-attaches to the
+    same partial file).  Resume safety comes from idempotence, not
+    journaling: sweeps cover disjoint row ranges and encoding is a pure
+    function of ``(rows, spec)``, so rewriting a range after a crash is
+    bit-identical and the final sha256 matches an uninterrupted run.
+
+    Publish keeps the GLT011 discipline: readers only ever see ``root``
+    appear via ``os.replace``; the partial directory is never a valid
+    store (no manifest until finalize writes one as its last act).
+
+    ``int8`` needs an explicit pre-calibrated :class:`~glt_tpu.store.
+    quant.QuantSpec` (calibration is a whole-matrix reduction a
+    streaming writer cannot do); ``raw``/``bf16`` need none.
+    """
+
+    def __init__(self, root: str, num_rows: int, dim: int,
+                 logical_dtype=np.float32, codec: str = "raw",
+                 spec: Optional["quant.QuantSpec"] = None,
+                 overwrite: bool = False):
+        self.root = os.path.abspath(root)
+        if os.path.exists(self.root) and not overwrite:
+            raise StoreError(
+                f"feature store target already exists: {self.root}")
+        self.num_rows, self.dim = int(num_rows), int(dim)
+        if spec is None:
+            if codec == "int8":
+                raise StoreError(
+                    "int8 streaming writes need an explicit QuantSpec "
+                    "(per-column calibration is a whole-matrix pass)")
+            spec = (quant.raw_spec(logical_dtype) if codec == "raw"
+                    else quant.QuantSpec(codec, np.dtype(np.float32)))
+        self.codec = spec.codec
+        self.spec = spec
+        self.storage_dtype = quant.storage_dtype(self.codec,
+                                                 spec.logical_dtype)
+        self._overwrite = overwrite
+        parent = os.path.dirname(self.root) or "."
+        os.makedirs(parent, exist_ok=True)
+        self._tmp = os.path.join(
+            parent, f".partial-{os.path.basename(self.root)}")
+        os.makedirs(self._tmp, exist_ok=True)
+        self._data_path = os.path.join(self._tmp, DATA_NAME)
+        nbytes = self.num_rows * self.dim * self.storage_dtype.itemsize
+        reattach = (os.path.exists(self._data_path)
+                    and os.path.getsize(self._data_path) == nbytes)
+        self._mm = np.memmap(self._data_path, dtype=self.storage_dtype,
+                             mode="r+" if reattach else "w+",
+                             shape=(self.num_rows, self.dim))
+        self.reattached = reattach
+        self._finalized = False
+
+    def write_rows(self, lo: int, rows: np.ndarray) -> None:
+        """Encode and land ``rows`` at row offset ``lo`` (idempotent)."""
+        if self._finalized:
+            raise StoreError("write_rows after finalize")
+        rows = np.asarray(rows)
+        hi = lo + rows.shape[0]
+        if lo < 0 or hi > self.num_rows or rows.shape[1] != self.dim:
+            raise StoreError(
+                f"write_rows range [{lo}, {hi}) x {rows.shape[1]} out of "
+                f"bounds for [{self.num_rows}, {self.dim}] store")
+        self._mm[lo:hi] = quant.encode_with_spec(rows, self.spec)
+
+    def flush(self) -> None:
+        """Flush landed rows to the partial file (checkpoint barrier:
+        a resumed writer re-attaches to everything flushed here)."""
+        if not self._finalized:
+            self._mm.flush()
+
+    def abort(self) -> None:
+        """Drop the partial tree (nothing was ever visible at root)."""
+        self._mm = None
+        shutil.rmtree(self._tmp, ignore_errors=True)
+
+    def finalize(self) -> str:
+        """Flush, checksum, write the manifest and publish atomically."""
+        if self._finalized:
+            return self.root
+        self._mm.flush()
+        self._mm = None
+        with open(self._data_path, "rb") as fh:
+            os.fsync(fh.fileno())
+        manifest = {
+            "format_version": FORMAT_VERSION,
+            "dtype": np.dtype(self.spec.logical_dtype).str,
+            "shape": [self.num_rows, self.dim],
+            "sha256": _sha256(self._data_path),
+        }
+        manifest.update(quant.spec_to_manifest(self.spec))
+        with open(os.path.join(self._tmp, MANIFEST_NAME), "w") as fh:
+            json.dump(manifest, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        _fsync_dir(self._tmp)
+        parent = os.path.dirname(self.root) or "."
+        if os.path.exists(self.root):
+            if not self._overwrite:
+                raise StoreError(
+                    f"feature store target appeared during write: "
+                    f"{self.root}")
+            trash = os.path.join(
+                parent,
+                f".trash-{os.path.basename(self.root)}-{os.getpid()}")
+            os.replace(self.root, trash)
+            os.replace(self._tmp, self.root)
+            shutil.rmtree(trash, ignore_errors=True)
+        else:
+            os.replace(self._tmp, self.root)
+        _fsync_dir(parent)
+        self._finalized = True
+        return self.root

@@ -1,0 +1,281 @@
+"""Compressed row codecs for the feature tiers: bf16 and per-column int8.
+
+Every feature tier — HBM hot table, DRAM stager, disk store — is
+bandwidth-bound (r05 roofline; GIDS and PyTorch-Direct in PAPERS.md
+reach the same conclusion), so bytes-per-row is the one knob that
+multiplies *capacity and throughput at all three levels at once*.  This
+module is the single sanctioned place where feature bytes are narrowed:
+
+* ``bf16`` — dtype widening only.  Each f32 value is rounded to its
+  nearest bfloat16 (8-bit mantissa); decode is a plain ``astype`` back
+  to f32.  2x smaller rows, no calibration state.
+* ``int8`` — per-column affine quantization.  Column ``j`` stores
+  ``q = clip(round((x - zero[j]) / scale[j]), -127, 127)`` with
+  ``scale = (cmax - cmin) / 253`` computed over the column in float64
+  and ``zero`` the column midpoint SNAPPED to an exact multiple of
+  ``scale`` (``zero = k * scale`` for integer ``k``).  4x smaller rows;
+  ``scale`` and ``zero`` ride in the store manifest.
+
+Error contract (tested in ``tests/test_quant.py``): for every in-range
+value, ``|x - dequantize(quantize(x))| <= scale[j] / 2`` per column up
+to f32 representation error (relative ``2**-23`` of the decoded value)
+— the half-step bound of round-to-nearest; 253 levels (not 254) keep
+the bound valid at the column extremes despite the snapped midpoint.  A
+constant column has ``scale == 0`` and round-trips *exactly* (``q ==
+0``, ``dq == zero``).
+
+Decode has exactly one formula per codec, shared verbatim by the Pallas
+on-chip epilogue and the XLA fallback so the A/B seam stays
+bit-identical:
+
+* widen (bf16):  ``x.astype(float32)``  — NOT ``x * 1 + 0``, which
+  would flip ``-0.0`` to ``+0.0``.
+* affine (int8): ``where(scale > 0, (x.astype(float32) + k) * scale,
+  zero)`` with ``k = rint(zero / scale)`` the integer-valued f32
+  zero point.
+
+The affine form is add-then-multiply BY DESIGN: ``x * scale + zero``
+is FMA-contractable, and XLA contracts it into a single-rounding fused
+op in some program contexts but not others (measured: the Pallas
+interpret arm and the post-gather arm disagreed by 1 ulp, and
+``lax.optimization_barrier`` does not block contraction).  No hardware
+fuses ``(a + b) * c``, so every rounding step of the add-then-mul form
+is forced and the two seam arms agree bit-for-bit on every backend.
+``zero`` snapped to ``k * scale`` is what makes the two forms
+equivalent; ``|k|`` is clamped to ``2**23`` so ``q + k`` stays exact in
+f32 (a column whose offset/step ratio exceeds that is outside int8's
+representable regime anyway).
+
+``dequantize(0)`` for int8 is ``zero``, not 0 — padding rows must be
+zeroed AFTER dequantization everywhere (the gather epilogues and the
+tiered merge both do).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+#: Supported row codecs. "raw" is the identity (storage dtype == logical
+#: dtype); the compressed codecs always decode to float32.
+CODECS = ("raw", "bf16", "int8")
+
+# Quantized range: symmetric [-127, 127].  253 levels (not 254) leave a
+# half-step of headroom at each extreme, so the snapped zero point never
+# pushes round() past ±127 and the scale/2 bound holds at cmin/cmax.
+_QMAX = 127.0
+_QLEVELS = 253.0
+# |k| cap keeping q + k exact in f32 (see module docstring).
+_KMAX = float(2 ** 23)
+
+
+class QuantSpec(NamedTuple):
+    """Everything needed to decode one store's rows.
+
+    ``scale``/``zero`` are ``[dim]`` float32 vectors for ``int8`` and
+    ``None`` otherwise.  ``logical_dtype`` is what decode produces
+    (always float32 for the compressed codecs).
+    """
+
+    codec: str
+    logical_dtype: np.dtype
+    scale: Optional[np.ndarray] = None
+    zero: Optional[np.ndarray] = None
+
+    @property
+    def is_compressed(self) -> bool:
+        return self.codec != "raw"
+
+
+def storage_dtype(codec: str, logical_dtype) -> np.dtype:
+    """The on-disk / on-wire element dtype for ``codec``."""
+    if codec == "raw":
+        return np.dtype(logical_dtype)
+    if codec == "bf16":
+        return np.dtype(ml_dtypes.bfloat16)
+    if codec == "int8":
+        return np.dtype(np.int8)
+    raise ValueError(f"unknown feature codec {codec!r}; expected {CODECS}")
+
+
+def raw_spec(logical_dtype) -> QuantSpec:
+    return QuantSpec("raw", np.dtype(logical_dtype))
+
+
+def encode(array: np.ndarray, codec: str) -> tuple:
+    """Encode ``array`` (``[N, d]`` float) under ``codec``.
+
+    Returns ``(encoded, spec)`` where ``encoded`` has the storage dtype
+    and ``spec`` is the :class:`QuantSpec` that decodes it.
+    """
+    array = np.asarray(array)
+    if codec == "raw":
+        return array, raw_spec(array.dtype)
+    if codec == "bf16":
+        return (array.astype(ml_dtypes.bfloat16),
+                QuantSpec("bf16", np.dtype(np.float32)))
+    if codec == "int8":
+        spec = calibrate_int8(array)
+        return quantize_int8(array, spec), spec
+    raise ValueError(f"unknown feature codec {codec!r}; expected {CODECS}")
+
+
+def calibrate_int8(array: np.ndarray) -> QuantSpec:
+    """Per-column affine parameters over the full matrix, in float64.
+
+    ``zero`` is the column midpoint snapped to an exact integer multiple
+    of the f32 ``scale`` (module docstring: what makes the
+    contraction-proof decode form equivalent to ``q * scale + zero``).
+    """
+    a = np.asarray(array, np.float64)
+    if a.size == 0:
+        d = a.shape[1] if a.ndim == 2 else 0
+        return QuantSpec("int8", np.dtype(np.float32),
+                         np.zeros(d, np.float32), np.zeros(d, np.float32))
+    cmin = a.min(axis=0)
+    cmax = a.max(axis=0)
+    scale = ((cmax - cmin) / _QLEVELS).astype(np.float32)
+    s64 = scale.astype(np.float64)
+    mid = (cmax + cmin) / 2.0
+    k = np.where(s64 > 0.0, np.rint(mid / np.where(s64 > 0.0, s64, 1.0)),
+                 0.0)
+    k = np.clip(k, -_KMAX, _KMAX)
+    # k * s64 is exact in f64 (|k| <= 2^23, s has 24 significant bits);
+    # the f32 cast is the single rounding decode reproduces.
+    zero = np.where(s64 > 0.0, (k * s64).astype(np.float32),
+                    mid.astype(np.float32))
+    return QuantSpec("int8", np.dtype(np.float32),
+                     scale, zero.astype(np.float32))
+
+
+def zero_point(spec: QuantSpec) -> np.ndarray:
+    """The integer-valued f32 ``k`` with ``zero == k * scale`` per column.
+
+    Recovered from the manifest pair by one correctly-rounded division:
+    ``zero = fl(k * scale)`` is within ``eps * |k|`` of ``k * scale``,
+    so ``rint(zero / scale)`` lands back on ``k`` exactly.
+    """
+    scale = np.asarray(spec.scale, np.float64)
+    zero = np.asarray(spec.zero, np.float64)
+    safe = np.where(scale > 0.0, scale, 1.0)
+    k = np.where(scale > 0.0, np.rint(zero / safe), 0.0)
+    return np.clip(k, -_KMAX, _KMAX).astype(np.float32)
+
+
+def quantize_int8(array: np.ndarray, spec: QuantSpec) -> np.ndarray:
+    """``[N, d]`` float -> int8 codes under ``spec`` (host-side)."""
+    a = np.asarray(array, np.float64)
+    scale = np.asarray(spec.scale, np.float64)
+    zero = np.asarray(spec.zero, np.float64)
+    # Constant columns (scale == 0) always encode to 0 (decode == zero).
+    safe = np.where(scale > 0.0, scale, 1.0)
+    q = np.rint((a - zero) / safe)
+    q = np.where(scale > 0.0, q, 0.0)
+    return np.clip(q, -_QMAX, _QMAX).astype(np.int8)
+
+
+def encode_with_spec(rows: np.ndarray, spec: QuantSpec) -> np.ndarray:
+    """Encode ``rows`` under an already-fixed ``spec`` (streaming writes)."""
+    rows = np.asarray(rows)
+    if spec.codec == "raw":
+        return np.ascontiguousarray(rows, spec.logical_dtype)
+    if spec.codec == "bf16":
+        return np.ascontiguousarray(rows).astype(ml_dtypes.bfloat16)
+    if spec.codec == "int8":
+        return quantize_int8(rows, spec)
+    raise ValueError(f"unknown feature codec {spec.codec!r}")
+
+
+def decode(encoded: np.ndarray, spec: QuantSpec) -> np.ndarray:
+    """Host-side decode — numpy mirror of :func:`dequantize`."""
+    if spec.codec == "raw":
+        return np.asarray(encoded)
+    if spec.codec == "bf16":
+        return np.asarray(encoded).astype(np.float32)
+    if spec.codec == "int8":
+        scale = np.asarray(spec.scale, np.float32)
+        zero = np.asarray(spec.zero, np.float32)
+        k = zero_point(spec)
+        wide = (np.asarray(encoded).astype(np.float32) + k) * scale
+        return np.where(scale > 0.0, wide, zero)
+    raise ValueError(f"unknown feature codec {spec.codec!r}")
+
+
+def dequantize(x, spec: QuantSpec):
+    """THE device-side decode formula (jnp), shared by both seam arms.
+
+    The Pallas epilogue kernels inline exactly these expressions; the
+    XLA fallback calls this function on the gathered rows.  Any edit
+    here must be mirrored in ``ops/gather_pallas.py`` /
+    ``ops/fused_frontier.py`` or the cross-arm bit tests fail.
+    """
+    if spec.codec == "raw":
+        return x
+    if spec.codec == "bf16":
+        return x.astype(jnp.float32)
+    if spec.codec == "int8":
+        scale = jnp.asarray(spec.scale, jnp.float32)
+        zero = jnp.asarray(spec.zero, jnp.float32)
+        k = jnp.asarray(zero_point(spec))
+        # Add-then-mul: contraction-proof, so every rounding is forced
+        # and both seam arms agree bit-for-bit (module docstring).
+        wide = (x.astype(jnp.float32) + k) * scale
+        return jnp.where(scale > 0.0, wide, zero)
+    raise ValueError(f"unknown feature codec {spec.codec!r}")
+
+
+#: Sublane count of the packed scale/zero kernel input: the f32 tiling
+#: floor (8, 128), so the block passes GLT019 without a special case.
+SCALE_ZERO_ROWS = 8
+
+
+def scale_zero_rows(spec: QuantSpec, dim: int) -> np.ndarray:
+    """``[8, dim]`` f32 kernel input: row 0 = scale, row 1 = zero,
+    row 2 = the integer zero point ``k`` (:func:`zero_point`).
+
+    The dequant epilogue kernels take the affine vectors as one VMEM
+    block; a ``(3, d)`` block would violate the f32 sublane floor
+    (GLT019), so they ride in the first rows of an 8-row tile.  For the
+    widen codec the block is (1, 0, 0) so the same kernel signature
+    serves both modes.
+    """
+    out = np.zeros((SCALE_ZERO_ROWS, dim), np.float32)
+    if spec.codec == "int8":
+        out[0, :] = np.asarray(spec.scale, np.float32)
+        out[1, :] = np.asarray(spec.zero, np.float32)
+        out[2, :] = zero_point(spec)
+    else:
+        out[0, :] = 1.0
+    return out
+
+
+def spec_to_manifest(spec: QuantSpec) -> dict:
+    """Manifest fragment for a compressed store (empty for raw)."""
+    if spec.codec == "raw":
+        return {}
+    out = {"codec": spec.codec}
+    if spec.codec == "int8":
+        out["quant"] = {
+            "scale": [float(v) for v in np.asarray(spec.scale)],
+            "zero": [float(v) for v in np.asarray(spec.zero)],
+        }
+    return out
+
+
+def spec_from_manifest(man: dict) -> QuantSpec:
+    """Decode spec from a store manifest (handles legacy raw manifests)."""
+    codec = man.get("codec", "raw")
+    logical = np.dtype(man["dtype"])
+    if codec == "raw":
+        return QuantSpec("raw", logical)
+    if codec == "bf16":
+        return QuantSpec("bf16", logical)
+    if codec == "int8":
+        q = man.get("quant") or {}
+        return QuantSpec(
+            "int8", logical,
+            np.asarray(q.get("scale", []), np.float32),
+            np.asarray(q.get("zero", []), np.float32))
+    raise ValueError(f"unknown feature codec {codec!r} in manifest")

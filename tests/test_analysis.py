@@ -2272,6 +2272,7 @@ class TestUnknownAxisName:
         assert "'shard'" in out[0].message
         assert "'host'" in out[0].message and "'chip'" in out[0].message
 
+
     def test_hier_exchange_on_2d_mesh_clean(self):
         """The sanctioned hierarchical pattern — intra-host all_to_all
         over the ICI axis, dedup, cross-host all_to_all over the DCN
@@ -2321,6 +2322,77 @@ class TestUnknownAxisName:
         assert "reduce_all" in out[0].message
 
 
+class TestLossyDtypeNarrowing:
+    """GLT022: narrowing .astype casts outside store/quant.py."""
+
+    def test_narrow_casts_fire(self):
+        src = """
+        import numpy as np
+        import jax.numpy as jnp
+        import ml_dtypes
+
+        def stage(rows):
+            a = rows.astype(np.float16)
+            b = rows.astype(jnp.bfloat16)
+            c = rows.astype(ml_dtypes.bfloat16)
+            d = rows.astype("int8")
+            e = rows.astype(np.dtype("uint8"))
+            return a, b, c, d, e
+        """
+        out = findings_for(src, "lossy-dtype-narrowing")
+        assert len(out) == 5
+        assert all("store/quant.py" in f.message for f in out)
+        assert "numpy.float16" in out[0].message
+
+    def test_widening_and_id_casts_clean(self):
+        src = """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def stage(rows, ids):
+            a = rows.astype(np.float32)        # widening / identity
+            b = rows.astype(jnp.float64)
+            c = ids.astype(np.int32)           # GLT004's territory
+            d = rows.astype(rows.dtype)        # dynamic target
+            e = rows.astype(a.dtype)
+            return a, b, c, d, e
+        """
+        assert findings_for(src, "lossy-dtype-narrowing") == []
+
+    def test_quant_module_exempt(self):
+        """The codec module is the one place narrowing is legal — its
+        casts carry manifest metadata and the bounded-error contract."""
+        src = textwrap.dedent("""
+            import numpy as np
+
+            def encode(rows):
+                return rows.astype(np.int8)
+        """)
+        from glt_tpu.analysis import analyze_source
+        hits = [f for f in analyze_source(src, "glt_tpu/store/quant.py")
+                if f.rule == "lossy-dtype-narrowing"]
+        assert hits == []
+        # same source under any other path fires
+        hits = [f for f in analyze_source(src, "glt_tpu/store/disk.py")
+                if f.rule == "lossy-dtype-narrowing"]
+        assert len(hits) == 1
+
+    def test_suppression_comment(self):
+        src = """
+        import numpy as np
+
+        def stage(rows):
+            return rows.astype(np.float16)  # gltlint: disable=GLT022
+        """
+        assert findings_for(src, "lossy-dtype-narrowing") == []
+
+    def test_tree_is_clean(self):
+        """No narrowing casts outside quant.py anywhere in glt_tpu —
+        the ISSUE-18 baseline stays empty."""
+        proc = _run_cli("glt_tpu", "--rule=GLT022")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
 def test_device_program_rules_clean_on_ops_and_parallel():
     """Real-tree smoke: the device-program passes (GLT017-021) verify
     every committed kernel and shard_map body with zero findings —
@@ -2350,7 +2422,7 @@ def test_rule_registry_complete():
         "unbalanced-profiler-capture",
         "vmem-budget-exceeded", "unbalanced-dma-ring",
         "unaligned-tile-shape", "divergent-collective",
-        "unknown-axis-name",
+        "unknown-axis-name", "lossy-dtype-narrowing",
     }
 
 

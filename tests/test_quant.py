@@ -1,0 +1,270 @@
+"""Feature codec property tests (ISSUE 18 tentpole + satellite 2).
+
+The quant module is the ONE place feature bytes may narrow
+(GLT022 enforces that statically); these tests pin its contracts:
+
+* bounded error — ``|x - dq(q(x))| <= scale/2`` per column for int8
+  (up to f32 representation error), bf16's native half-mantissa bound;
+* exactness where exactness is promised — constant columns (scale 0),
+  the snapped zero point, the integer offset ``k`` recovered from the
+  manifest pair;
+* saturation and degenerate shapes never produce NaN/Inf or wrap;
+* the numpy ``decode`` mirror, the jnp ``dequantize`` formula, the
+  Pallas gather epilogue (interpret mode) and the XLA post-gather arm
+  all agree BIT-for-bit — the A/B seam contract the raw paths already
+  carry, extended to compressed rows.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from glt_tpu.store import quant
+
+jax = pytest.importorskip("jax")
+
+
+def _int8_tol(spec, x):
+    scale = np.asarray(spec.scale, np.float64)
+    return scale[None, :] / 2 + 1e-5 * np.abs(x) + 1e-8
+
+
+class TestInt8Codec:
+    def test_bounded_error_random(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(257, 96)).astype(np.float32) * 3.0
+        enc, spec = quant.encode(x, "int8")
+        assert enc.dtype == np.int8 and spec.codec == "int8"
+        dq = quant.decode(enc, spec)
+        assert dq.dtype == np.float32
+        assert (np.abs(dq.astype(np.float64) - x)
+                <= _int8_tol(spec, x)).all()
+
+    def test_bounded_error_zipf_columns(self):
+        # Wildly different per-column ranges: per-column scale/zero is
+        # the whole point (a global scale would destroy narrow columns).
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(128, 64)).astype(np.float32)
+        x *= rng.zipf(1.5, size=64).astype(np.float32)[None, :]
+        x[:, 7] += 1e4                     # large-offset column
+        enc, spec = quant.encode(x, "int8")
+        dq = quant.decode(enc, spec)
+        assert (np.abs(dq.astype(np.float64) - x)
+                <= _int8_tol(spec, x)).all()
+
+    def test_constant_columns_exact(self):
+        x = np.tile(np.float32([-3.25, 0.0, 7.5, 1e-30]), (40, 1))
+        enc, spec = quant.encode(x, "int8")
+        assert (np.asarray(spec.scale)[[0, 1, 2, 3]] == 0).all()
+        assert (enc == 0).all()            # q = 0 when scale == 0
+        dq = quant.decode(enc, spec)
+        assert np.array_equal(dq, x)       # bit-exact, not just close
+
+    def test_saturation_clamps_to_qmax(self):
+        x = np.float32([[-100.0], [100.0], [0.0]])
+        enc, spec = quant.encode(x, "int8")
+        assert enc.min() == -127 and enc.max() == 127
+        dq = quant.decode(enc, spec)
+        assert (np.abs(dq.astype(np.float64) - x)
+                <= _int8_tol(spec, x)).all()
+
+    def test_zero_point_is_exact_scale_multiple(self):
+        # zero = fl(k * scale) with integer-valued f32 k: the decode
+        # offset recovered from the manifest pair must be exactly k.
+        rng = np.random.default_rng(2)
+        x = (rng.normal(size=(64, 32)) * 50 + 1000).astype(np.float32)
+        _, spec = quant.encode(x, "int8")
+        k = quant.zero_point(spec)
+        assert (k == np.rint(k)).all()
+        assert np.abs(k).max() <= 2.0**23
+        live = np.asarray(spec.scale) > 0
+        recon = (k[live].astype(np.float64)
+                 * np.asarray(spec.scale, np.float64)[live])
+        assert np.array_equal(recon.astype(np.float32),
+                              np.asarray(spec.zero)[live])
+
+    def test_rows_1_and_dim_1(self):
+        for shape in ((1, 8), (16, 1), (1, 1)):
+            x = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+            enc, spec = quant.encode(x, "int8")
+            dq = quant.decode(enc, spec)
+            assert np.isfinite(dq).all()
+            assert (np.abs(dq.astype(np.float64) - x)
+                    <= _int8_tol(spec, x)).all()
+
+
+class TestBf16Codec:
+    def test_round_trip_half_mantissa_bound(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(200, 64)).astype(np.float32) * 100
+        enc, spec = quant.encode(x, "bf16")
+        assert enc.dtype == quant.storage_dtype("bf16", np.float32)
+        dq = quant.decode(enc, spec)
+        # bf16 keeps 8 mantissa bits: relative error <= 2^-8.
+        assert (np.abs(dq - x) <= np.abs(x) * 2.0**-8 + 1e-38).all()
+
+    def test_exact_values_survive(self):
+        # Powers of two and small ints are exactly representable.
+        x = np.float32([[1.0, -2.0, 0.5, 96.0, 0.0, -0.0]])
+        enc, spec = quant.encode(x, "bf16")
+        dq = quant.decode(enc, spec)
+        assert np.array_equal(dq, x)
+        assert np.signbit(dq[0, 5])        # -0.0 keeps its sign bit
+
+    def test_subnormals_do_not_blow_up(self):
+        # f32 subnormals flush toward bf16's tiny grid; result must be
+        # finite, tiny, and monotone-safe (never amplified).
+        x = np.float32([[1e-40, -1e-40, 1.1754944e-38, 1e-44]])
+        enc, spec = quant.encode(x, "bf16")
+        dq = quant.decode(enc, spec)
+        assert np.isfinite(dq).all()
+        assert (np.abs(dq) <= 2 * np.abs(x) + 1e-45).all()
+
+
+class TestSpecPlumbing:
+    def test_manifest_round_trip(self):
+        x = np.random.default_rng(4).normal(size=(32, 16)).astype(
+            np.float32)
+        for codec in quant.CODECS:
+            _, spec = quant.encode(x, codec)
+            man = {}
+            man.update(quant.spec_to_manifest(spec))
+            back = quant.spec_from_manifest(
+                {"dtype": "<f4", **man})
+            assert back.codec == spec.codec
+            if codec == "int8":
+                assert np.array_equal(back.scale, spec.scale)
+                assert np.array_equal(back.zero, spec.zero)
+
+    def test_legacy_manifest_is_raw(self):
+        spec = quant.spec_from_manifest({"dtype": "<f4"})
+        assert spec.codec == "raw" and not spec.is_compressed
+
+    def test_unknown_codec_rejected(self):
+        with pytest.raises(Exception):
+            quant.encode(np.zeros((2, 2), np.float32), "fp4")
+
+    def test_encode_with_spec_streaming_matches_whole(self):
+        # FeatureStoreWriter encodes sweep-by-sweep with a fixed spec;
+        # chunked encoding must equal whole-matrix encoding bit for bit.
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(100, 24)).astype(np.float32)
+        whole, spec = quant.encode(x, "int8")
+        parts = np.concatenate(
+            [quant.encode_with_spec(x[i:i + 7], spec)
+             for i in range(0, 100, 7)])
+        assert np.array_equal(whole, parts)
+
+    def test_scale_zero_rows_shape_and_widen(self):
+        x = np.random.default_rng(6).normal(size=(16, 8)).astype(
+            np.float32)
+        _, spec = quant.encode(x, "int8")
+        sz = quant.scale_zero_rows(spec, 8)
+        assert sz.shape == (quant.SCALE_ZERO_ROWS, 8)
+        assert np.array_equal(sz[0], np.asarray(spec.scale))
+        assert np.array_equal(sz[1], np.asarray(spec.zero))
+        assert np.array_equal(sz[2], quant.zero_point(spec))
+        _, bspec = quant.encode(x, "bf16")
+        bsz = quant.scale_zero_rows(bspec, 8)
+        assert (bsz[0] == 1.0).all() and (bsz[1] == 0.0).all()
+
+
+class TestNumpyJnpAgreement:
+    def test_decode_equals_dequantize_bitwise(self):
+        rng = np.random.default_rng(7)
+        x = (rng.normal(size=(64, 32)) * 20 - 5).astype(np.float32)
+        for codec in ("bf16", "int8"):
+            enc, spec = quant.encode(x, codec)
+            host = quant.decode(enc, spec)
+            dev = np.asarray(quant.dequantize(jnp.asarray(enc), spec))
+            assert np.array_equal(host, dev), codec
+
+
+class TestCrossArmBitIdentity:
+    """Pallas interpret arm == XLA arm, bit for bit (the seam the raw
+    gather already guarantees, extended to compressed tables)."""
+
+    @pytest.mark.parametrize("codec", ["bf16", "int8"])
+    @pytest.mark.parametrize("d", [128, 256, 64])
+    def test_gather_rows_arms_agree(self, codec, d):
+        from glt_tpu.ops.gather_pallas import (gather_rows,
+                                               gather_rows_pallas_dq)
+
+        rng = np.random.default_rng(8)
+        x = (rng.normal(size=(300, d)) * 10).astype(np.float32)
+        enc, spec = quant.encode(x, codec)
+        table = jnp.asarray(enc)
+        idx = jnp.asarray(
+            np.r_[rng.integers(0, 300, 120), [-1, -1]].astype(np.int32))
+        pallas = np.asarray(gather_rows_pallas_dq(
+            table, idx, spec, interpret=True))
+        xla = np.asarray(gather_rows(table, idx, force="xla",
+                                     dequant=spec))
+        assert pallas.dtype == np.float32
+        # -1 ids clip like any out-of-range gather at this level (the
+        # Feature layer owns the padding-to-zero contract); both arms
+        # must still agree bit for bit on them.
+        assert np.array_equal(pallas, xla)
+
+    @pytest.mark.parametrize("codec", ["bf16", "int8"])
+    def test_fused_frontier_arms_agree(self, codec):
+        from glt_tpu.ops.fused_frontier import fused_frontier
+
+        rng = np.random.default_rng(9)
+        x = (rng.normal(size=(256, 128)) * 4).astype(np.float32)
+        enc, spec = quant.encode(x, codec)
+        table = jnp.asarray(enc)
+        ids = np.r_[rng.integers(0, 256, 90),
+                    [-1] * 6, rng.integers(0, 256, 32)].astype(np.int32)
+        fused = fused_frontier(table, jnp.asarray(ids),
+                               force="interpret", dequant=spec)
+        unfused = fused_frontier(table, jnp.asarray(ids), force="xla",
+                                 dequant=spec)
+        assert np.array_equal(np.asarray(fused.features),
+                              np.asarray(unfused.features))
+        assert np.array_equal(np.asarray(fused.unique_ids),
+                              np.asarray(unfused.unique_ids))
+        # reference: per-position dequantized gather, -1 rows zeroed
+        full = quant.decode(enc, spec)
+        ref = np.where(ids[:, None] >= 0, full[np.clip(ids, 0, 255)], 0)
+        assert np.allclose(np.asarray(unfused.features), ref, atol=1e-6)
+
+    def test_raw_paths_bit_identical_to_pre_codec(self):
+        # dequant=None and a raw spec are byte-for-byte the old path.
+        from glt_tpu.ops.gather_pallas import gather_rows
+
+        rng = np.random.default_rng(10)
+        x = rng.normal(size=(128, 128)).astype(np.float32)
+        table = jnp.asarray(x)
+        idx = jnp.asarray(rng.integers(0, 128, 64).astype(np.int32))
+        base = np.asarray(gather_rows(table, idx, force="xla"))
+        spec = quant.raw_spec(np.float32)
+        assert np.array_equal(
+            base, np.asarray(gather_rows(table, idx, force="xla",
+                                         dequant=spec)))
+
+    def test_all_padding_rows_zero_at_feature_level(self, tmp_path):
+        # dequantize(0) = zero != 0 for int8, so the padding contract
+        # (-1 id -> all-zero row) must be re-imposed AFTER dequant; a
+        # table offset keeps 0.0 out of the codebook so a missed
+        # re-zero is visible.
+        from glt_tpu.data.feature import Feature
+        from glt_tpu.store import DiskFeatureStore, write_feature_store
+
+        x = (np.random.default_rng(11).normal(size=(64, 128)) + 100
+             ).astype(np.float32)          # zero IS NOT a codebook point
+        write_feature_store(str(tmp_path / "s"), x, codec="int8")
+        store = DiskFeatureStore(str(tmp_path / "s"))
+        idx = np.full((16,), -1, np.int32)
+        for split in (0.0, 1.0):
+            feat = Feature.from_store(store, 1 << 20, split_ratio=split)
+            out = np.asarray(feat.gather(jnp.asarray(idx)))
+            feat.close()
+            assert (out == 0).all(), split
+        # the fused fallback zeroes its padded unique slots too
+        from glt_tpu.ops.fused_frontier import fused_frontier
+
+        enc, spec = quant.encode(x, "int8")
+        out = fused_frontier(jnp.asarray(enc),
+                             jnp.asarray(idx), force="xla", dequant=spec)
+        assert (np.asarray(out.features) == 0).all()

@@ -77,3 +77,77 @@ def test_digits_knn_pipeline_accuracy():
     # chance/logreg-minus-slack.  (The example's full config reaches
     # ~0.98; this test runs a smaller model for CI speed.)
     assert acc > 0.93, acc
+
+
+@pytest.mark.skipif(not os.path.isdir(DATA), reason="dataset not built")
+def test_digits_int8_store_accuracy_parity(tmp_path):
+    """Compressed feature tier on real data (ISSUE 18): train once on
+    raw features, then evaluate the SAME weights twice — raw features
+    vs the same matrix round-tripped through an int8 feature store and
+    re-gathered through the on-chip dequant epilogue.  The bounded
+    per-column error (<= scale/2 ~ 0.03 on 0..16 pixel columns) must
+    not move accuracy by more than half a point."""
+    import jax
+    import optax
+
+    import examples.datasets as exds
+    from glt_tpu.data.feature import Feature
+    from glt_tpu.loader import NeighborLoader
+    from glt_tpu.models import (
+        GraphSAGE,
+        TrainState,
+        make_eval_step,
+        make_scanned_node_train_step,
+        run_scanned_epoch,
+    )
+    from glt_tpu.sampler import NeighborSampler
+    from glt_tpu.store import DiskFeatureStore, write_feature_store
+
+    exds.DATA_ROOT = os.path.join(REPO, "data")
+    ds, train_idx = exds._from_disk("digits-knn", graph_mode="HOST")
+    test_idx = np.load(os.path.join(DATA, "test_idx.npy"))
+    feats = np.asarray(ds.get_node_feature()._host_full, np.float32)
+
+    bs, fanout = 256, [10, 5]
+    model = GraphSAGE(hidden_features=64, out_features=10,
+                      num_layers=len(fanout), dtype=jax.numpy.bfloat16)
+    tx = optax.adam(3e-3)
+    sampler = NeighborSampler(ds.get_graph(), fanout, batch_size=bs,
+                              with_edge=False)
+    labels = np.asarray(ds.get_node_label())
+    x0 = jax.numpy.zeros((sampler.node_capacity, 64), jax.numpy.float32)
+    ei0 = jax.numpy.full((2, sampler.edge_capacity), -1, jax.numpy.int32)
+    m0 = jax.numpy.zeros((sampler.edge_capacity,), bool)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x0, ei0, m0)
+    state = TrainState(params=params, opt_state=tx.init(params),
+                       step=jax.numpy.zeros((), jax.numpy.int32))
+    step = make_scanned_node_train_step(model, tx, sampler,
+                                        ds.get_node_feature(), labels, bs)
+    rng = np.random.default_rng(0)
+    for epoch in range(12):
+        state, losses, accs, _ = run_scanned_epoch(
+            step, state, train_idx, bs, 2, rng,
+            jax.random.PRNGKey(100 + epoch))
+
+    write_feature_store(str(tmp_path / "digits_int8"), feats,
+                        codec="int8")
+    store = DiskFeatureStore(str(tmp_path / "digits_int8"))
+    feat_q = Feature.from_store(store, dram_budget_bytes=feats.nbytes // 4)
+
+    ev = make_eval_step(model, batch_size=bs)
+
+    def eval_with(feature):
+        ds.node_features = feature
+        loader = NeighborLoader(ds, fanout, test_idx, batch_size=bs,
+                                sampler=sampler)
+        batches = [(float(ev(state.params, b)[1]), b.batch_size)
+                   for b in loader]
+        return float(np.average([a for a, _ in batches],
+                                weights=[w for _, w in batches]))
+
+    try:
+        acc_raw = eval_with(Feature(feats, split_ratio=0.0))
+        acc_q = eval_with(feat_q)
+    finally:
+        feat_q.close()
+    assert abs(acc_raw - acc_q) <= 0.005, (acc_raw, acc_q)

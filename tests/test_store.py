@@ -696,3 +696,95 @@ class TestPipelineOnDiskTier:
             store, f.num_shards, f.hot_per_shard / f.nodes_per_shard)
         with pytest.raises(ValueError, match="cold_store"):
             TieredTrainPipeline(sampler, train, f3, mesh)
+
+
+class TestOverwriteAndWriter:
+    """ISSUE 18 satellite: ``write_feature_store(overwrite=)`` and the
+    streaming :class:`FeatureStoreWriter` behind the refresh driver."""
+
+    def test_overwrite_replaces_atomically(self, tmp_path):
+        root, old = _write(tmp_path, n=16, d=4, seed=1)
+        new = np.full((8, 2), 7.0, np.float32)
+        write_feature_store(root, new, overwrite=True)
+        store = DiskFeatureStore(root)
+        assert store.shape == (8, 2)
+        np.testing.assert_array_equal(store.read_rows(np.arange(8)), new)
+        store.verify()  # manifest sha matches the NEW bytes
+        # GLT011: no partial/trash residue beside the published root
+        residue = [p for p in os.listdir(tmp_path)
+                   if p.startswith((".partial-", ".trash-")) or ".tmp" in p]
+        assert residue == []
+
+    def test_overwrite_false_is_default_refusal(self, tmp_path):
+        root, old = _write(tmp_path, n=4, d=2)
+        with pytest.raises(StoreError, match="already exists"):
+            write_feature_store(root, old, overwrite=False)
+        # refusal must not have disturbed the existing store
+        DiskFeatureStore(root).verify()
+
+    def test_writer_roundtrip_sha_valid(self, tmp_path):
+        from glt_tpu.store.disk import FeatureStoreWriter
+
+        rng = np.random.default_rng(3)
+        arr = rng.normal(size=(40, 6)).astype(np.float32)
+        w = FeatureStoreWriter(str(tmp_path / "w"), 40, 6)
+        for lo in range(0, 40, 16):
+            w.write_rows(lo, arr[lo:lo + 16])
+        root = w.finalize()
+        store = DiskFeatureStore(root)
+        store.verify()
+        np.testing.assert_array_equal(store.read_rows(np.arange(40)), arr)
+
+    def test_writer_reattach_rewrite_bit_identical(self, tmp_path):
+        """Crash-resume contract: a second writer re-attaches to the
+        partial file and rewriting any range reproduces the exact same
+        published bytes (sha256 equality)."""
+        from glt_tpu.store.disk import FeatureStoreWriter
+
+        rng = np.random.default_rng(4)
+        arr = rng.normal(size=(32, 8)).astype(np.float32)
+
+        w1 = FeatureStoreWriter(str(tmp_path / "a"), 32, 8)
+        for lo in range(0, 32, 8):
+            w1.write_rows(lo, arr[lo:lo + 8])
+        sha_a = json.load(open(os.path.join(w1.finalize(),
+                                            MANIFEST_NAME)))["sha256"]
+
+        w2 = FeatureStoreWriter(str(tmp_path / "b"), 32, 8)
+        w2.write_rows(0, arr[:8])
+        w2.write_rows(8, arr[8:16])
+        w2.flush()
+        del w2  # "crash" after two sweeps
+        w3 = FeatureStoreWriter(str(tmp_path / "b"), 32, 8)
+        assert w3.reattached
+        w3.write_rows(8, arr[8:16])  # idempotent rewrite
+        for lo in range(16, 32, 8):
+            w3.write_rows(lo, arr[lo:lo + 8])
+        sha_b = json.load(open(os.path.join(w3.finalize(),
+                                            MANIFEST_NAME)))["sha256"]
+        assert sha_a == sha_b
+
+    def test_writer_abort_leaves_nothing(self, tmp_path):
+        from glt_tpu.store.disk import FeatureStoreWriter
+
+        w = FeatureStoreWriter(str(tmp_path / "gone"), 8, 2)
+        w.write_rows(0, np.ones((8, 2), np.float32))
+        w.abort()
+        assert not os.path.exists(str(tmp_path / "gone"))
+        assert os.listdir(tmp_path) == []
+
+    def test_writer_int8_requires_spec(self, tmp_path):
+        from glt_tpu.store.disk import FeatureStoreWriter
+
+        with pytest.raises(StoreError, match="QuantSpec"):
+            FeatureStoreWriter(str(tmp_path / "q"), 8, 2, codec="int8")
+
+    def test_writer_range_bounds_checked(self, tmp_path):
+        from glt_tpu.store.disk import FeatureStoreWriter
+
+        w = FeatureStoreWriter(str(tmp_path / "r"), 8, 2)
+        with pytest.raises(StoreError, match="out of.*bounds"):
+            w.write_rows(6, np.zeros((4, 2), np.float32))
+        with pytest.raises(StoreError, match="out of.*bounds"):
+            w.write_rows(0, np.zeros((2, 3), np.float32))
+        w.abort()
