@@ -1,0 +1,334 @@
+"""Fleet routing bench: affinity vs random A/B + kill-recovery chaos.
+
+What it measures (ISSUE 19 acceptance, tracked by obs.regress):
+
+  * ``fleet_affinity_hit_rate``  — fleet-wide seed-LRU hit rate under a
+                                   zipf workload routed by the shard
+                                   table (partition-affinity policy).
+  * ``fleet_random_hit_rate``    — the SAME workload over a fresh,
+                                   identical fleet routed uniform-random:
+                                   the A/B baseline whose cache churn
+                                   affinity exists to beat.
+  * ``fleet_affinity_gain``      — affinity - random (asserted > 0: the
+                                   acceptance bar).
+  * ``fleet_p99_ms``             — p99 request latency over the whole
+                                   kill-recovery run, INCLUDING the
+                                   failover window (bounded-tail proof).
+  * ``fleet_recovery_s``         — seconds from the replica kill until
+                                   the survivors' windowed hit rate
+                                   first re-enters the pre-kill band.
+  * ``fleet_structured_reject_frac`` — fraction of chaos-run requests
+                                   answered with a structured
+                                   ServingError (shed/deadline class).
+  * ``fleet_unstructured_errors``— anything else escaping the router
+                                   (asserted == 0: every failure mode
+                                   is structured).
+  * ``fleet_hit_rate_reconverged`` — 1.0 when every survivor's windowed
+                                   hit rate recovered to within 0.10 of
+                                   its pre-kill rate (asserted).
+
+Methodology: the A/B arms each get a FRESH fleet (caches start cold
+both times) and replay the same pre-drawn zipf seed sequence closed
+loop.  The chaos phase is open loop (arrival times pre-drawn from a
+Poisson process), so the dying replica cannot slow the offered load —
+the condition that exposes failover and shed behavior.
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/bench_fleet.py
+Prints one JSON line (also written atomically to $GLT_BENCH_OUT).
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_ring_dataset(n, dim=8):
+    from glt_tpu.data import Dataset
+
+    src = np.repeat(np.arange(n), 2)
+    dst = np.concatenate([[(i + 1) % n, (i + 2) % n] for i in range(n)])
+    feat = np.arange(n, dtype=np.float32)[:, None] * np.ones((1, dim),
+                                                             np.float32)
+    labels = np.arange(n, dtype=np.int32) % 7
+    return (Dataset()
+            .init_graph(np.stack([src, dst]), graph_mode="HOST",
+                        num_nodes=n)
+            .init_node_features(feat)
+            .init_node_labels(labels))
+
+
+def make_fleet(n, count, args, fault_plans=None):
+    from glt_tpu.distributed import init_server
+    from glt_tpu.serving import ServingOptions
+
+    servers = []
+    for i in range(count):
+        opts = ServingOptions(
+            num_neighbors=list(args.fanouts),
+            seed_buckets=tuple(args.buckets),
+            max_seeds_per_request=4,
+            max_batch_requests=16,
+            max_wait_ms=1.0,
+            max_inflight=128,
+            default_deadline_ms=60_000.0,
+            seed_cache_entries=args.cache_entries)
+        srv = init_server(
+            build_ring_dataset(n), serving=opts,
+            fault_plan=fault_plans[i] if fault_plans else None)
+        srv.serving.engine.warmup()
+        servers.append(srv)
+    return servers
+
+
+def fleet_hit_counts(router):
+    """(hits, lookups) summed over live replicas' seed LRUs."""
+    hits = lookups = 0
+    for st in router.replica_stats().values():
+        if st and st.get("enabled"):
+            hits += int(st["seed_cache_hits"])
+            lookups += int(st["seed_cache_lookups"])
+    return hits, lookups
+
+
+class _Recorder:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.lat_ms = []
+        self.ok = 0
+        self.structured = 0
+        self.unstructured = []
+
+    def add(self, kind, ms=None, detail=None):
+        with self.lock:
+            if kind == "ok":
+                self.ok += 1
+                self.lat_ms.append(ms)
+            elif kind == "structured":
+                self.structured += 1
+            else:
+                self.unstructured.append(detail)
+
+    @property
+    def total(self):
+        with self.lock:
+            return self.ok + self.structured + len(self.unstructured)
+
+
+def run_load(router, seeds, n, rec, workers=4, arrivals=None):
+    """Fire ``seeds`` (one request each) through ``router``.  Closed
+    loop when ``arrivals`` is None; otherwise open loop — worker i
+    handles requests i, i+workers, ... each at its scheduled arrival."""
+    from glt_tpu.serving import ServingError
+
+    count = len(seeds)
+    t0 = time.monotonic()
+
+    def worker(w):
+        for i in range(w, count, workers):
+            if arrivals is not None:
+                delay = t0 + arrivals[i] - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+            seed = int(seeds[i])
+            t1 = time.perf_counter()
+            try:
+                batch = router.subgraph([seed])
+                ms = (time.perf_counter() - t1) * 1e3
+                got = np.asarray(batch.batch).tolist()
+                assert got[0] == seed, (got, seed)   # validity first
+                rec.add("ok", ms=ms)
+            except ServingError:
+                rec.add("structured")
+            except BaseException as e:  # noqa: BLE001 — the bug class
+                rec.add("unstructured", detail=repr(e))
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+        assert not t.is_alive(), "load worker hung"
+
+
+def ab_arm(policy, n, probs, seeds, args):
+    """One A/B arm: fresh fleet, fixed seed replay, fleet hit rate."""
+    from glt_tpu.serving import FleetRouter
+
+    servers = make_fleet(n, args.replicas, args)
+    router = FleetRouter(
+        [s.addr for s in servers],
+        scores=probs if policy == "affinity" else None,
+        num_shards=args.num_shards, policy=policy,
+        request_timeout=30.0, start_probes=False,
+        health_deadline_s=600.0)
+    try:
+        rec = _Recorder()
+        run_load(router, seeds, n, rec, workers=args.workers)
+        assert rec.unstructured == [], rec.unstructured[:3]
+        hits, lookups = fleet_hit_counts(router)
+        return hits / max(1, lookups)
+    finally:
+        router.close()
+        for s in servers:
+            s.shutdown()
+
+
+def chaos_run(n, probs, args, rng, out):
+    """Kill replica 0 under open-loop Poisson zipf load; measure tail
+    latency, structured-only failure, and hit-rate re-convergence."""
+    from glt_tpu.serving import FleetRouter
+    from glt_tpu.testing.faults import FaultPlan
+
+    plans = [FaultPlan() for _ in range(args.replicas)]
+    servers = make_fleet(n, args.replicas, args, fault_plans=plans)
+    router = FleetRouter(
+        [s.addr for s in servers], scores=probs,
+        num_shards=args.num_shards, request_timeout=30.0,
+        start_probes=False, health_deadline_s=600.0,
+        backoff_base=0.01, backoff_cap=0.05)
+    rec = _Recorder()
+
+    def phase(count, rate_hz):
+        seeds = rng.choice(n, size=count, p=probs)
+        arrivals = np.cumsum(rng.exponential(1.0 / rate_hz, size=count))
+        run_load(router, seeds, n, rec, workers=args.workers,
+                 arrivals=arrivals)
+
+    def survivor_rates(keys):
+        rates = {}
+        for k, st in router.replica_stats().items():
+            if k in keys and st and st.get("enabled"):
+                rates[k] = (int(st["seed_cache_hits"]),
+                            int(st["seed_cache_lookups"]))
+        return rates
+
+    try:
+        # warm the affinity caches, snapshot the pre-kill hit rates
+        phase(args.warm_requests, args.rate_hz)
+        warm_lat = len(rec.lat_ms)
+        key0 = router.table.replicas[0]
+        survivors = [k for k in router.table.replicas if k != key0]
+        pre = survivor_rates(survivors)
+        pre_rate = {k: h / max(1, lk) for k, (h, lk) in pre.items()}
+
+        # kill replica 0 counter-exactly under load
+        t_kill = [None]
+
+        def kill():
+            t_kill[0] = time.monotonic()
+            threading.Thread(target=servers[0].kill,
+                             daemon=True).start()
+
+        plans[0].replica_kill_hook = kill
+        plans[0].kill_replica_after_serving_batches = 5
+        phase(args.kill_requests, args.rate_hz)
+        assert plans[0].injected_replica_kills == 1, \
+            "kill fault never fired — raise kill_requests"
+        assert not router.fleet_status()[key0]["alive"]
+
+        # recovery: windowed hit rate per chunk until back in band
+        recovered_at = None
+        for _ in range(args.recovery_chunks):
+            base = survivor_rates(survivors)
+            phase(args.chunk_requests, args.rate_hz)
+            now = survivor_rates(survivors)
+            ok = True
+            for k in survivors:
+                d_hits = now[k][0] - base[k][0]
+                d_lookups = now[k][1] - base[k][1]
+                rate = d_hits / max(1, d_lookups)
+                ok = ok and rate >= pre_rate[k] - 0.10
+            if ok:
+                recovered_at = time.monotonic()
+                break
+
+        out["fleet_p99_ms"] = round(float(np.percentile(
+            np.asarray(rec.lat_ms[warm_lat:]), 99)), 3)
+        out["fleet_structured_reject_frac"] = round(
+            rec.structured / max(1, rec.total), 4)
+        out["fleet_unstructured_errors"] = len(rec.unstructured)
+        out["fleet_hit_rate_reconverged"] = float(
+            recovered_at is not None)
+        out["fleet_recovery_s"] = (
+            round(recovered_at - t_kill[0], 3)
+            if recovered_at is not None else None)
+        out["fleet_replica_kills"] = int(
+            plans[0].injected_replica_kills)
+
+        assert rec.unstructured == [], rec.unstructured[:3]
+        assert recovered_at is not None, (
+            "survivor hit rate never re-entered the pre-kill band",
+            pre_rate)
+    finally:
+        router.close()
+        for s in servers:
+            s.shutdown()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    small = os.environ.get("GLT_BENCH_SCALE") == "small"
+    ap.add_argument("--nodes", type=int, default=256 if small else 512)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--num-shards", type=int, default=48)
+    ap.add_argument("--fanouts", type=int, nargs="+", default=[3, 2])
+    ap.add_argument("--buckets", type=int, nargs="+", default=[8, 32])
+    ap.add_argument("--cache-entries", type=int,
+                    default=64 if small else 96)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--zipf-alpha", type=float, default=1.2)
+    ap.add_argument("--ab-requests", type=int,
+                    default=400 if small else 800)
+    ap.add_argument("--rate-hz", type=float, default=120.0)
+    ap.add_argument("--warm-requests", type=int,
+                    default=200 if small else 300)
+    ap.add_argument("--kill-requests", type=int,
+                    default=150 if small else 200)
+    ap.add_argument("--chunk-requests", type=int,
+                    default=120 if small else 160)
+    ap.add_argument("--recovery-chunks", type=int, default=5)
+    args = ap.parse_args()
+
+    n = args.nodes
+    rng = np.random.default_rng(11)
+    probs = 1.0 / (np.arange(1, n + 1) ** args.zipf_alpha)
+    probs /= probs.sum()
+
+    out = {"nodes": n, "replicas": args.replicas,
+           "num_shards": args.num_shards,
+           "zipf_alpha": args.zipf_alpha}
+
+    # -- phase 1: affinity vs random A/B (fresh fleet per arm) ------------
+    ab_seeds = rng.choice(n, size=args.ab_requests, p=probs)
+    affinity = ab_arm("affinity", n, probs, ab_seeds, args)
+    random_ = ab_arm("random", n, probs, ab_seeds, args)
+    out["fleet_affinity_hit_rate"] = round(affinity, 4)
+    out["fleet_random_hit_rate"] = round(random_, 4)
+    out["fleet_affinity_gain"] = round(affinity - random_, 4)
+    assert affinity > random_, (
+        f"partition-affinity routing must beat random on cache hit "
+        f"rate: affinity={affinity:.4f} random={random_:.4f}")
+
+    # -- phase 2: kill a replica under open-loop Poisson load -------------
+    chaos_run(n, probs, args, rng, out)
+
+    line = json.dumps(out)
+    print(line, flush=True)
+    bench_out = os.environ.get("GLT_BENCH_OUT")
+    if bench_out:
+        tmp = f"{bench_out}.tmp-{os.getpid()}"
+        with open(tmp, "w") as fh:
+            fh.write(line + "\n")
+        os.replace(tmp, bench_out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1695,6 +1695,123 @@ class LossyDtypeNarrowing(Rule):
         return findings
 
 
+# ---------------------------------------------------------------------------
+# GLT023 unjittered-retry-loop
+# ---------------------------------------------------------------------------
+
+@register
+class UnjitteredRetryLoop(Rule):
+    """Constant-duration sleep inside a network retry loop.
+
+    A retry loop that catches transport errors and then sleeps a fixed
+    constant re-synchronizes every client that failed together: when a
+    replica dies, all of its in-flight callers observe the reset within
+    milliseconds of each other, all sleep exactly X seconds, and all
+    hammer the successor in the same instant — a retry storm that turns
+    one failure into rolling overload.  Every retry path in this tree
+    (``subgraph_with_retry``, ``RemoteServerConnection``,
+    ``FleetRouter`` failover) paces as
+    ``min(cap, base * 2**attempt) * (0.5 + 0.5 * rng.random())`` —
+    exponential backoff with full-range jitter — so a failed cohort
+    decorrelates instead of marching in lockstep.
+
+    Flagged: a ``time.sleep(X)`` or ``<event>.wait(X)`` whose duration
+    is a compile-time constant (literals and arithmetic over literals),
+    inside a ``while``/``for`` loop that also catches a transport-class
+    exception (the ``OSError``/``ConnectionError`` family,
+    ``TimeoutError``, ``EOFError``, ``socket.*``, ``*ProtocolError``).
+    A duration with any computed component — a name, an attribute, a
+    call — is clean: that computation is exactly where backoff and
+    jitter live.  Loops that catch only ``Exception`` (heartbeat/poll
+    loops pacing themselves, not re-contacting a failed peer) are not
+    retry loops and stay clean.
+    """
+    name = "unjittered-retry-loop"
+    code = "GLT023"
+    severity = Severity.ERROR
+    description = ("constant-duration sleep in a network retry loop "
+                   "(failed cohort retries in lockstep — use jittered "
+                   "exponential backoff)")
+
+    _NETWORK_EXCS = {
+        "OSError", "IOError", "ConnectionError", "ConnectionResetError",
+        "ConnectionRefusedError", "ConnectionAbortedError",
+        "BrokenPipeError", "TimeoutError", "EOFError",
+        "socket.timeout", "socket.error", "socket.gaierror",
+        "socket.herror",
+    }
+
+    def check(self, module: ModuleInfo, project=None) -> List[Finding]:
+        findings: List[Finding] = []
+        flagged: Set[int] = set()
+        roots = [module.tree] + [s.node for s in module.scopes]
+        for root in roots:
+            for node in _walk_own(root):
+                if not isinstance(node, (ast.While, ast.For)):
+                    continue
+                if not self._has_network_handler(module, node):
+                    continue
+                for call in _walk_own(node):
+                    if (isinstance(call, ast.Call)
+                            and id(call) not in flagged
+                            and self._is_const_sleep(module, call)):
+                        flagged.add(id(call))
+                        findings.append(self.finding(
+                            module, call,
+                            f"constant sleep in a loop retrying "
+                            f"transport errors — every caller that "
+                            f"failed together retries together; pace "
+                            f"with jittered exponential backoff "
+                            f"(min(cap, base * 2**attempt) * random "
+                            f"jitter)"))
+        return findings
+
+    # -- helpers ----------------------------------------------------------
+    def _has_network_handler(self, module: ModuleInfo,
+                             loop: ast.AST) -> bool:
+        for node in _walk_own(loop):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            types = node.type
+            if types is None:
+                continue    # bare except: a poll loop, not a retry loop
+            elts = types.elts if isinstance(types, ast.Tuple) else [types]
+            if any(self._is_network_exc(module, e) for e in elts):
+                return True
+        return False
+
+    def _is_network_exc(self, module: ModuleInfo, expr: ast.expr) -> bool:
+        d = _dotted(expr)
+        if d is None:
+            return False
+        resolved = module.imports.resolve(expr) or d
+        if d in self._NETWORK_EXCS or resolved in self._NETWORK_EXCS:
+            return True
+        return d.split(".")[-1].endswith("ProtocolError")
+
+    def _is_const_sleep(self, module: ModuleInfo, call: ast.Call) -> bool:
+        if not call.args or call.keywords:
+            return False
+        name = module.call_name(call)
+        is_sleep = name == "time.sleep"
+        is_wait = (isinstance(call.func, ast.Attribute)
+                   and call.func.attr == "wait")
+        if not (is_sleep or is_wait):
+            return False
+        return self._const_duration(call.args[0])
+
+    def _const_duration(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Constant):
+            return isinstance(node.value, (int, float)) \
+                and not isinstance(node.value, bool)
+        if isinstance(node, ast.UnaryOp):
+            return self._const_duration(node.operand)
+        if isinstance(node, ast.BinOp):
+            return (self._const_duration(node.left)
+                    and self._const_duration(node.right))
+        return False
+
+
 def _iter_const_ints(node: ast.expr) -> Iterator[int]:
     if isinstance(node, ast.Constant) and isinstance(node.value, int):
         yield node.value

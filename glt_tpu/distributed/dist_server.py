@@ -490,6 +490,11 @@ class DistServer:
             self.serving = ServingFront(dataset, serving,
                                         fault_plan=fault_plan)
         self._producers: Dict[int, _Producer] = {}
+        # Live accepted sockets, tracked so kill() can sever them
+        # abruptly (chaos testing: clients must see a raw transport
+        # error, never a polite structured goodbye).
+        self._live_conns: set = set()
+        self._conns_lock = threading.Lock()
         # client_key -> producer id: a client that reconnects and
         # re-creates (its lease expired, or it restarted) first tears
         # down its previous producer instead of leaking it.
@@ -623,6 +628,30 @@ class DistServer:
         if op == "fleet_health":
             return {"peers": self.supervisor.status(),
                     "live_producers": self.live_producers()}
+        if op == "fleet_hello":
+            # Router/controller handshake (docs/serving.md "Fleet"): a
+            # fleet-aware replica answers with its protocol number and
+            # whether serving is mounted; the caller's name is beaten
+            # into the supervisor so replica-side `fleet_health` shows
+            # the router as a peer.  A pre-19 replica answers this op
+            # with its unknown-op fatal error — the router's cue to
+            # degrade that replica to direct (legacy) routing.
+            peer = str(req.get("peer", "router"))
+            self.supervisor.beat(peer)
+            return {"ok": True, "protocol": 1,
+                    "serving": self.serving is not None,
+                    "stale_after_s": self.supervisor.deadline_secs}
+        if op == "fleet_shed":
+            # Fleet-wide shed/reopen broadcast from the FleetController:
+            # the alert dict is exactly what a local SloMonitor would
+            # deliver, so one burn-rate evaluation at the controller
+            # drives every replica's admission bound.  A pre-19 replica
+            # fails this op fatally (the controller tolerates that).
+            if self.serving is None:
+                return {"ok": False, "enabled": False}
+            self.serving.slo_alert(dict(req.get("alert") or {}))
+            return {"ok": True, "enabled": True,
+                    "shed_frac": self.serving.stats()["shed_frac"]}
         if op == "serving_stats":
             # Occupancy + rejection counters of the serving front
             # (docs/serving.md); enabled=False when serving is off so a
@@ -751,6 +780,8 @@ class DistServer:
     def _serve_conn(self, conn) -> None:
         if self._fault_plan is not None:
             conn = self._fault_plan.wrap(conn)
+        with self._conns_lock:
+            self._live_conns.add(conn)
         try:
             while True:
                 kind, data = recv_frame(conn, max_len=self.max_frame_bytes)
@@ -843,10 +874,39 @@ class DistServer:
             except OSError:
                 pass
         finally:
+            with self._conns_lock:
+                self._live_conns.discard(conn)
             conn.close()
 
     def wait_for_exit(self, timeout: Optional[float] = None) -> None:
         self._stop.wait(timeout)
+
+    def kill(self) -> None:
+        """Die like a crashed process (chaos testing): stop accepting,
+        sever every live connection mid-stream, stop the serving
+        dispatcher.  No structured goodbyes — in-flight clients see a
+        raw transport error (ECONNRESET/EOF), which is exactly the
+        failure the fleet router's failover path must absorb.  Producer
+        teardown is left to the lease reaper, as a real crash would."""
+        self._stop.set()
+        _flight.record("server.killed", addr=list(self.addr))
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns = list(self._live_conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self.serving is not None:
+            self.serving.stop()
 
     def shutdown(self) -> None:
         self._stop.set()

@@ -300,7 +300,14 @@ class Supervisor:
 
     # -- queries -----------------------------------------------------------
     def status(self) -> Dict[str, Dict[str, Any]]:
-        """Structured health table (the ``fleet_health`` op's payload)."""
+        """Structured health table (the ``fleet_health`` op's payload).
+
+        ``stale_after_s`` is the structured staleness verdict: seconds of
+        remaining silence before this peer's deadline expires (negative
+        once it is already past).  Callers — the fleet router above all —
+        read the sign instead of re-implementing ``deadline - age``
+        themselves, so the deadline math lives in exactly one place.
+        """
         now = time.monotonic()
         with self._lock:
             return {
@@ -308,6 +315,8 @@ class Supervisor:
                     "alive": not p.dead,
                     "age_s": round(now - p.last_seen, 3),
                     "deadline_s": p.deadline_s,
+                    "stale_after_s": round(
+                        p.deadline_s - (now - p.last_seen), 3),
                     "step": p.step,
                 }
                 for p in self._peers.values()

@@ -151,6 +151,21 @@ EXPLICIT_DIRECTIONS: Dict[str, int] = {
     "refresh_stage_errors": DOWN,
     "gather_effective_speedup_bf16": UP,
     "gather_effective_speedup_int8": UP,
+    # Fleet routing + failover (ISSUE 19, benchmarks/bench_fleet.py,
+    # docs/serving.md "Fleet"): affinity hit rate up-good and random is
+    # its A/B control (a workload reading); the kill-recovery tail and
+    # re-convergence time down-good; the structured-reject fraction is
+    # a policy reading, but ANY unstructured error is a bug, so that
+    # count tracks DOWN (and the bench asserts it is zero).
+    "fleet_affinity_hit_rate": UP,
+    "fleet_random_hit_rate": NEUTRAL,
+    "fleet_affinity_gain": UP,
+    "fleet_p99_ms": DOWN,
+    "fleet_recovery_s": DOWN,
+    "fleet_structured_reject_frac": NEUTRAL,
+    "fleet_unstructured_errors": DOWN,
+    "fleet_hit_rate_reconverged": UP,
+    "fleet_replica_kills": NEUTRAL,
 }
 
 #: ``(suffix, direction)`` checked in order after the explicit table.

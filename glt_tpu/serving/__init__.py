@@ -17,16 +17,21 @@ Layers (see docs/serving.md):
   engine     SubgraphEngine — bucketed device programs + per-request split
   front      ServingFront — admission queue + coalescing dispatcher
   client     InferenceClient — thin request client w/ per-op timeouts
+  router     ShardTable + FleetRouter — partition-affinity routing,
+             replica health, exactly-once failover
+  fleet      FleetSpec + FleetController — fleet-wide SLO shed/reopen,
+             merged postmortems on replica death
 
 Server side, pass ``init_server(dataset, serving=ServingOptions(...))``;
 the ``subgraph_request`` wire op and ``serving_stats`` live on the same
 framed protocol the training loaders use.
 """
-from .client import InferenceClient
+from .client import InferenceClient, retryable_transport
 from .engine import CoalescedSample, SubgraphEngine
 from .errors import (
     BadRequest,
     DeadlineExceeded,
+    NoHealthyReplica,
     Overloaded,
     ServingDisabled,
     ServingDown,
@@ -34,14 +39,20 @@ from .errors import (
     ServingTimeout,
     error_from_response,
 )
+from .fleet import FleetController, FleetSpec, default_fleet_specs
 from .front import ServingFront
 from .options import ServingOptions
+from .router import FleetRouter, ShardTable
 
 __all__ = [
     "BadRequest",
     "CoalescedSample",
     "DeadlineExceeded",
+    "FleetController",
+    "FleetRouter",
+    "FleetSpec",
     "InferenceClient",
+    "NoHealthyReplica",
     "Overloaded",
     "ServingDisabled",
     "ServingDown",
@@ -49,6 +60,9 @@ __all__ = [
     "ServingFront",
     "ServingOptions",
     "ServingTimeout",
+    "ShardTable",
     "SubgraphEngine",
+    "default_fleet_specs",
     "error_from_response",
+    "retryable_transport",
 ]

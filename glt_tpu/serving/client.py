@@ -24,11 +24,31 @@ from ..distributed.sample_message import message_to_batch
 from ..obs import metrics as _metrics
 from ..obs import propagate as _prop
 from ..obs.trace import span as _span
-from .errors import ServingError
+from .errors import DeadlineExceeded, ServingError
 
 _H_CLIENT = _metrics.histogram(
     "glt.serving.client_ms",
     "client-observed subgraph round trip (serialize+wire+serve)")
+
+
+def retryable_transport(exc: BaseException) -> bool:
+    """True for transport-class failures a retry can plausibly fix —
+    ECONNRESET, socket timeouts, EOF mid-frame, desynced framing — as
+    opposed to structured serving rejections (the server speaking
+    clearly) which must surface to the caller's policy untouched.
+
+    ``RemoteServerConnection._exchange`` wraps its final transport
+    failure in a ``RuntimeError`` chained ``from`` the last retryable
+    exception, so the cause is inspected too (the fleet router and
+    ``subgraph_with_retry`` both classify through here).
+    """
+    if isinstance(exc, ServingError):
+        return False
+    if isinstance(exc, RemoteServerConnection.RETRYABLE):
+        return True
+    if isinstance(exc, RuntimeError):
+        return isinstance(exc.__cause__, RemoteServerConnection.RETRYABLE)
+    return False
 
 
 class InferenceClient:
@@ -57,6 +77,7 @@ class InferenceClient:
                  op_timeout_margin: float = 30.0,
                  max_retries: int = 1,
                  fallback_addrs: Sequence[Tuple[str, int]] = (),
+                 backoff_base: float = 0.05, backoff_cap: float = 2.0,
                  fault_plan=None, seed: int = 0,
                  to_device: bool = False):
         self.default_timeout = float(timeout)
@@ -65,6 +86,7 @@ class InferenceClient:
         self._retries = int(max_retries)
         self.conn = RemoteServerConnection(
             addr, max_retries=max_retries,
+            backoff_base=backoff_base, backoff_cap=backoff_cap,
             fallback_addrs=tuple(fallback_addrs),
             fault_plan=fault_plan, seed=seed)
 
@@ -106,24 +128,68 @@ class InferenceClient:
 
     def subgraph_with_retry(self, seeds, timeout: Optional[float] = None,
                             attempts: int = 3,
-                            max_backoff_s: float = 0.5):
-        """``subgraph`` plus honor-the-hint backoff on ``Overloaded``.
+                            max_backoff_s: float = 0.5,
+                            deadline_ms: Optional[float] = None):
+        """``subgraph`` plus a bounded, budgeted retry loop.
 
-        The polite client loop the bench uses under deliberate
-        overload; any other serving error propagates immediately.
+        Retries two failure classes, each with its own backoff policy:
+
+        * structured ``Overloaded`` — honor the server's
+          ``retry_after_ms`` hint (capped at ``max_backoff_s``);
+        * retryable transport errors (ECONNRESET, socket timeout, EOF
+          mid-frame — :func:`retryable_transport`) — the connection's
+          own exponential backoff with seeded jitter
+          (``backoff_base``/``backoff_cap``, the PR-4 parameters).
+
+        Any other serving error propagates immediately.  ``deadline_ms``
+        caps the TOTAL retry budget across every attempt and sleep (not
+        per-attempt): once elapsed time exceeds it the loop raises
+        :class:`~glt_tpu.serving.errors.DeadlineExceeded` chained from
+        the last failure, and each attempt's per-request timeout is
+        clipped to the remaining budget so a slow server cannot eat the
+        whole budget in one socket wait.
         """
         import time as _time
 
-        last: Optional[ServingError] = None
-        for _ in range(max(1, int(attempts))):
+        start = _time.monotonic()
+        budget_s = None if deadline_ms is None else float(deadline_ms) / 1e3
+
+        def remaining() -> Optional[float]:
+            if budget_s is None:
+                return None
+            return budget_s - (_time.monotonic() - start)
+
+        last: Optional[BaseException] = None
+        for attempt in range(max(1, int(attempts))):
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                raise DeadlineExceeded(
+                    f"retry budget of {deadline_ms:.0f} ms exhausted "
+                    f"after {attempt} attempt(s)") from last
+            t = self.default_timeout if timeout is None else float(timeout)
+            if rem is not None:
+                t = min(t, rem)
             try:
-                return self.subgraph(seeds, timeout=timeout)
+                return self.subgraph(seeds, timeout=t)
             except ServingError as e:
                 if e.code != "overloaded":
                     raise
                 last = e
                 hint = (e.retry_after_ms or 10.0) / 1e3
-                _time.sleep(min(max_backoff_s, hint))
+                sleep_s = min(max_backoff_s, hint)
+            except Exception as e:  # noqa: BLE001 — reclassified below
+                if not retryable_transport(e):
+                    raise
+                last = e
+                # The connection's own jittered exponential backoff
+                # (seeded rng: reproducible, decorrelated across clients).
+                sleep_s = min(self.conn.backoff_cap,
+                              self.conn.backoff_base * (2 ** attempt))
+                sleep_s *= 0.5 + 0.5 * self.conn._rng.random()
+            rem = remaining()
+            if rem is not None:
+                sleep_s = min(sleep_s, max(0.0, rem))
+            _time.sleep(sleep_s)
         raise last
 
     def stats(self) -> dict:

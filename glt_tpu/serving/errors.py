@@ -77,9 +77,20 @@ class ServingTimeout(ServingError):
     code = "serving_timeout"
 
 
+class NoHealthyReplica(ServingError):
+    """The fleet router exhausted its bounded failover budget: the
+    request's shard owner and its successor(s) were all dead or
+    unreachable.  Structured by design — a whole-fleet outage surfaces
+    as this error after a bounded number of jittered retries, never as
+    a hang or a raw socket traceback (the ``bounded_get`` discipline
+    applied to the client path)."""
+
+    code = "no_healthy_replica"
+
+
 _BY_CODE = {cls.code: cls for cls in (
     ServingError, Overloaded, DeadlineExceeded, BadRequest,
-    ServingDisabled, ServingDown, ServingTimeout)}
+    ServingDisabled, ServingDown, ServingTimeout, NoHealthyReplica)}
 
 #: Wire codes this module owns; ``RemoteServerConnection`` routes error
 #: responses with these codes through :func:`error_from_response`.

@@ -32,6 +32,7 @@ docs/observability.md):
 """
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
@@ -82,6 +83,9 @@ _H_E2E = _metrics.histogram(
 _M_SHED = _metrics.counter(
     "glt.serving.rejected_shed",
     "requests rejected early while an SLO burn alert sheds load")
+_G_SEED_CACHE = _metrics.gauge(
+    "glt.serving.seed_cache_hit_rate",
+    "hit rate of the replica's seed-affinity LRU (routing quality)")
 
 
 class _Pending:
@@ -137,6 +141,16 @@ class ServingFront:
         # the backlog drains instead of feeding the burn.  0.0 = open.
         self._shed_frac = 0.0
         self._shed_slo: Optional[str] = None
+        # Seed-affinity LRU: the measured stand-in for "this replica's
+        # HBM/DRAM cache has these nodes hot".  Counted per dispatched
+        # request (not per admission) so rejected work doesn't pollute
+        # the signal; capacity 0 disables it.  Fleet routing quality —
+        # affinity vs. hash-random — is read off this hit rate.
+        self._seed_cache: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        self._seed_cache_cap = int(options.seed_cache_entries)
+        self._seed_cache_hits = 0
+        self._seed_cache_lookups = 0
         # EWMA of micro-batch service time, seeding the retry-after hint
         # before the first batch lands (compile-heavy) with the wait knob.
         self._ewma_batch_ms = max(10.0, 2.0 * float(options.max_wait_ms))
@@ -295,6 +309,8 @@ class ServingFront:
             live.append(p)
         if not live:
             return
+        if self._seed_cache_cap > 0:
+            self._touch_seed_cache(live)
         _H_WIDTH.observe(len(live))
         _H_SEEDS.observe(sum(p.seeds.size for p in live))
         t0 = time.perf_counter()
@@ -327,6 +343,31 @@ class ServingFront:
             self._completed += len(live)
         _M_BATCHES.inc()
 
+    def _touch_seed_cache(self, live: List[_Pending]) -> None:
+        """Count every dispatched seed against the affinity LRU.
+
+        Only the dispatcher thread mutates the dict; the stats lock
+        covers the counters so :meth:`stats` reads a consistent pair.
+        """
+        cache, cap = self._seed_cache, self._seed_cache_cap
+        hits = lookups = 0
+        for p in live:
+            for s in p.seeds.tolist():
+                lookups += 1
+                if s in cache:
+                    hits += 1
+                    cache.move_to_end(s)
+                else:
+                    cache[s] = None
+                    if len(cache) > cap:
+                        cache.popitem(last=False)
+        with self._stats_lock:
+            self._seed_cache_hits += hits
+            self._seed_cache_lookups += lookups
+            hit_rate = (self._seed_cache_hits
+                        / max(1, self._seed_cache_lookups))
+        _G_SEED_CACHE.set(round(hit_rate, 6))
+
     # -- introspection / lifecycle ------------------------------------------
     def stats(self) -> dict:
         """JSON-able occupancy/outcome counters (the ``serving_stats``
@@ -345,6 +386,11 @@ class ServingFront:
                 "shed_slo": self._shed_slo,
                 "ewma_batch_ms": round(self._ewma_batch_ms, 3),
                 "compiled_buckets": self.engine.compiled_buckets(),
+                "seed_cache_hits": self._seed_cache_hits,
+                "seed_cache_lookups": self._seed_cache_lookups,
+                "seed_cache_hit_rate": round(
+                    self._seed_cache_hits
+                    / max(1, self._seed_cache_lookups), 6),
             }
 
     def stop(self) -> None:

@@ -48,6 +48,14 @@ class ServingOptions:
       with_edge: include global edge ids in responses.
       frontier_cap: optional per-hop frontier cap forwarded to the
         sampler (memory knob for wide fanouts).
+      seed_cache_entries: capacity of the replica's seed-affinity LRU —
+        the stand-in for "this replica's HBM/DRAM cache has this node's
+        rows hot".  Every dispatched request counts its seeds against
+        the LRU (``seed_cache_hit_rate`` in ``stats()``), which is what
+        makes cache affinity a *measured* property of fleet routing:
+        partition-affinity routing keeps each replica's LRU on a stable
+        shard of the id space, hash-random routing churns it.  0
+        disables the bookkeeping.
       seed: base RNG seed for the serving samplers.
     """
 
@@ -62,6 +70,7 @@ class ServingOptions:
     with_labels: bool = True
     with_edge: bool = True
     frontier_cap: Optional[int] = None
+    seed_cache_entries: int = 4096
     seed: int = 0
 
     def __post_init__(self):
@@ -79,3 +88,5 @@ class ServingOptions:
             raise ValueError("max_batch_requests must be >= 1")
         if int(self.max_inflight) < 1:
             raise ValueError("max_inflight must be >= 1")
+        if int(self.seed_cache_entries) < 0:
+            raise ValueError("seed_cache_entries must be >= 0")

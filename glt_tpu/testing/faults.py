@@ -96,6 +96,13 @@ class FaultPlan:
     # coalesced batch would run, and must degrade to structured errors
     # for THAT batch's requests only (no poisoning of later batches).
     fail_serving_batch: Optional[int] = None
+    # Kill the whole replica after this many serving micro-batches
+    # (1-based): fires ``replica_kill_hook`` — a test-supplied closure,
+    # typically spawning a thread that calls ``DistServer.kill()`` so
+    # the replica dies abruptly mid-load (the fleet chaos scenario).
+    # The hook runs at most once and must not block the dispatcher.
+    kill_replica_after_serving_batches: Optional[int] = None
+    replica_kill_hook: Optional[object] = None
     # Only the first N accepted/established connections are faulty;
     # later ones run clean (lets a test end the weather deterministically).
     max_faulty_conns: Optional[int] = None
@@ -124,6 +131,7 @@ class FaultPlan:
         self.injected_delays = 0
         self.injected_preemptions = 0
         self.injected_serving_failures = 0
+        self.injected_replica_kills = 0
         self.injected_disk_failures = 0
         self.injected_disk_delays = 0
 
@@ -174,15 +182,25 @@ class FaultPlan:
 
     def on_serving_batch(self) -> None:
         """Called by the serving dispatcher before each micro-batch
-        (``fail_serving_batch``).  Raises a plain RuntimeError — the
-        engine-crash class the front must contain to the one batch."""
-        if self.fail_serving_batch is None:
+        (``fail_serving_batch`` raises a plain RuntimeError — the
+        engine-crash class the front must contain to the one batch;
+        ``kill_replica_after_serving_batches`` fires the replica kill
+        hook exactly once — whole-replica death under load)."""
+        if (self.fail_serving_batch is None
+                and self.kill_replica_after_serving_batches is None):
             return
         with self._lock:
             self._serving_batches += 1
-            fire = self._serving_batches == self.fail_serving_batch
+            n = self._serving_batches
+            fire = n == self.fail_serving_batch
+            kill = (n == self.kill_replica_after_serving_batches
+                    and self.replica_kill_hook is not None)
             if fire:
                 self.injected_serving_failures += 1
+            if kill:
+                self.injected_replica_kills += 1
+        if kill:
+            self.replica_kill_hook()
         if fire:
             raise RuntimeError(
                 f"fault injection: serving engine crashed on micro-batch "

@@ -2393,6 +2393,115 @@ class TestLossyDtypeNarrowing:
         assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+class TestUnjitteredRetryLoop:
+    """GLT023: constant-duration sleeps in network retry loops."""
+
+    def test_constant_sleep_in_retry_loop_fires(self):
+        src = """
+        import socket
+        import time
+
+        def fetch(conn):
+            while True:
+                try:
+                    return conn.request()
+                except (ConnectionResetError, socket.timeout):
+                    time.sleep(0.5)
+        """
+        out = findings_for(src, "unjittered-retry-loop")
+        assert len(out) == 1
+        assert "jittered exponential backoff" in out[0].message
+
+    def test_constant_wait_and_arithmetic_fire(self):
+        src = """
+        import time
+
+        def fetch(ev, conn):
+            for _ in range(5):
+                try:
+                    return conn.request()
+                except EOFError:
+                    ev.wait(2 * 0.25)
+
+        def fetch2(conn):
+            while True:
+                try:
+                    return conn.request()
+                except OSError:
+                    time.sleep(1 + 0.5)
+        """
+        assert len(findings_for(src, "unjittered-retry-loop")) == 2
+
+    def test_jittered_and_computed_sleeps_clean(self):
+        src = """
+        import time
+
+        def fetch(conn, rng):
+            attempt = 0
+            while True:
+                try:
+                    return conn.request()
+                except OSError:
+                    attempt += 1
+                    time.sleep(min(0.5, 0.05 * 2 ** attempt)
+                               * (0.5 + 0.5 * rng.random()))
+
+        def fetch2(conn, backoff):
+            while True:
+                try:
+                    return conn.request()
+                except ConnectionError:
+                    time.sleep(backoff)
+        """
+        assert findings_for(src, "unjittered-retry-loop") == []
+
+    def test_non_network_loops_clean(self):
+        """Heartbeat/poll loops pace themselves — catching bare
+        Exception (or nothing) is not retrying a peer."""
+        src = """
+        import time
+
+        def heartbeat(stop, probe):
+            while not stop.is_set():
+                try:
+                    probe()
+                except Exception:
+                    pass
+                stop.wait(1.0)
+
+        def spin(work):
+            for item in work:
+                time.sleep(0.01)
+
+        def key_retry(fn):
+            while True:
+                try:
+                    return fn()
+                except KeyError:
+                    time.sleep(0.1)
+        """
+        assert findings_for(src, "unjittered-retry-loop") == []
+
+    def test_suppression_comment(self):
+        src = """
+        import time
+
+        def fetch(conn):
+            while True:
+                try:
+                    return conn.request()
+                except OSError:
+                    time.sleep(0.5)  # gltlint: disable=GLT023
+        """
+        assert findings_for(src, "unjittered-retry-loop") == []
+
+    def test_tree_is_clean(self):
+        """Every retry loop in the tree paces with jittered backoff —
+        the ISSUE-19 baseline stays empty."""
+        proc = _run_cli("glt_tpu", "--rule=GLT023")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
 def test_device_program_rules_clean_on_ops_and_parallel():
     """Real-tree smoke: the device-program passes (GLT017-021) verify
     every committed kernel and shard_map body with zero findings —
@@ -2423,6 +2532,7 @@ def test_rule_registry_complete():
         "vmem-budget-exceeded", "unbalanced-dma-ring",
         "unaligned-tile-shape", "divergent-collective",
         "unknown-axis-name", "lossy-dtype-narrowing",
+        "unjittered-retry-loop",
     }
 
 
