@@ -96,17 +96,29 @@ def build_project(paths: Iterable[str]
 def analyze_project(project: Project,
                     rules: Optional[Sequence[Rule]] = None,
                     suppress: bool = True,
-                    rule_timings: Optional[Dict[str, float]] = None
+                    rule_timings: Optional[Dict[str, float]] = None,
+                    only_paths: Optional[Iterable[str]] = None
                     ) -> List[Finding]:
     """Run the given rules (default: all) over every project module.
 
     When ``rule_timings`` is given, each rule's cumulative wall time
     across all modules is accumulated into it (keyed by rule name) —
     the ``--profile`` per-pass table and the CI perf guard read this.
+
+    ``only_paths`` restricts the *rule passes* to those module paths
+    (the incremental ``--changed``/``--since`` mode): the whole file
+    set is still parsed into the project, so cross-file resolution and
+    effect summaries stay sound, but per-module rule work — the
+    dominant cost as the tree grows — runs only on the changed slice.
     """
     rules = list(rules) if rules is not None else all_rules()
+    selected = (None if only_paths is None
+                else {os.path.abspath(p) for p in only_paths})
     findings: List[Finding] = []
     for path in sorted(project.by_path):
+        if selected is not None \
+                and os.path.abspath(path) not in selected:
+            continue
         module = project.by_path[path]
         module_findings: List[Finding] = []
         for rule in rules:
@@ -157,6 +169,30 @@ def analyze_paths(paths: Iterable[str],
     return findings + analyze_project(project, rules)
 
 
+def _git_changed_files(since: str) -> Optional[List[str]]:
+    """Repo paths changed since ``since`` (tracked diffs + untracked
+    files), or None when git is unavailable — the caller falls back to
+    a full run rather than silently linting nothing."""
+    import subprocess
+
+    def run(*cmd: str) -> Optional[List[str]]:
+        try:
+            proc = subprocess.run(
+                list(cmd), capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        return [ln for ln in proc.stdout.splitlines() if ln]
+
+    diffed = run("git", "diff", "--name-only", since, "--")
+    if diffed is None:
+        return None
+    untracked = run("git", "ls-files", "--others",
+                    "--exclude-standard") or []
+    return sorted(set(diffed) | set(untracked))
+
+
 def _select_rules(select: Optional[str], ignore: Optional[str]
                   ) -> List[Rule]:
     by_key = {}
@@ -200,10 +236,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="comma-separated rule names/codes to skip")
     parser.add_argument("--strict", action="store_true",
                         help="treat warnings as errors for the exit code")
-    parser.add_argument("--format", choices=sorted(_FORMATTERS),
+    parser.add_argument("--format",
+                        choices=sorted(_FORMATTERS) + ["optable"],
                         default="text", dest="fmt",
                         help="report format (default: text; 'github' "
-                             "emits PR-inline workflow annotations)")
+                             "emits PR-inline workflow annotations; "
+                             "'optable' dumps the extracted wire-op "
+                             "table as the docs/distributed.md matrix "
+                             "instead of findings)")
+    parser.add_argument("--changed", action="store_true",
+                        help="incremental mode: run rule passes only "
+                             "on files changed vs HEAD (plus untracked "
+                             "files); the whole tree is still parsed "
+                             "so cross-file resolution stays sound")
+    parser.add_argument("--since", metavar="REV",
+                        help="like --changed, diffed against REV "
+                             "instead of HEAD")
     parser.add_argument("--baseline", metavar="FILE",
                         help="gate only on findings not recorded in this "
                              "baseline file")
@@ -235,6 +283,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     t0 = time.perf_counter()
     project, findings = build_project(args.paths)
     timings.append(("parse+symbols", time.perf_counter() - t0))
+
+    if args.fmt == "optable":
+        from .protocol import extract_op_table, format_op_table
+        print(format_op_table(extract_op_table(project)))
+        for f in findings:               # parse failures must not hide
+            print(f.format(), file=sys.stderr)
+        return 2 if findings else 0
+
+    only_paths: Optional[List[str]] = None
+    if args.changed or args.since:
+        only_paths = _git_changed_files(args.since or "HEAD")
+        if only_paths is None:
+            print("gltlint: --changed/--since needs git; running the "
+                  "full file set", file=sys.stderr)
+        elif args.profile:
+            print(f"gltlint --profile: incremental slice: "
+                  f"{len(only_paths)} changed file(s)", file=sys.stderr)
+
     if not args.rule:
         # Single-rule mode skips the forced build: a rule that needs
         # effects still triggers it lazily, but GLT017-021 style passes
@@ -245,7 +311,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     t0 = time.perf_counter()
     rule_timings: Dict[str, float] = {}
     findings = findings + analyze_project(
-        project, rules, rule_timings=rule_timings if args.profile else None)
+        project, rules,
+        rule_timings=rule_timings if args.profile else None,
+        only_paths=only_paths)
     timings.append(("rules", time.perf_counter() - t0))
 
     if args.write_baseline:

@@ -1825,10 +1825,14 @@ def all_rules() -> List[Rule]:
 
 
 # The concurrency rules (GLT008/GLT009), the Pallas device-program model
-# (GLT017-019, kernelmodel.py), and the shard_map collective checks
-# (GLT020/021, spmd.py) live in their own modules but register into the
-# same RULES table; importing here completes the registry for every
-# entry point (cli, tests, programmatic use).
+# (GLT017-019, kernelmodel.py), the shard_map collective checks
+# (GLT020/021, spmd.py), the wire-protocol verification (GLT024-026,
+# protocol.py), and the thread-safety pass (GLT027, threads.py) live in
+# their own modules but register into the same RULES table; importing
+# here completes the registry for every entry point (cli, tests,
+# programmatic use).
 from . import concurrency  # noqa: E402,F401  (registration side effect)
 from . import kernelmodel  # noqa: E402,F401  (registration side effect)
 from . import spmd  # noqa: E402,F401  (registration side effect)
+from . import protocol  # noqa: E402,F401  (registration side effect)
+from . import threads  # noqa: E402,F401  (registration side effect)

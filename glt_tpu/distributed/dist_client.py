@@ -84,6 +84,22 @@ class UnknownProducerError(RuntimeError):
     the producer (or the loader) to continue."""
 
 
+# Server codes with no typed client-side exception that the client
+# classifies as FATAL for the request at hand: the server spoke clearly
+# (wrong epoch, a dead/unknown producer's sampling, a wedged pipeline),
+# so retrying or failing over the same request cannot help.  Keeping the
+# set explicit — instead of letting unknown codes fall through to the
+# same generic error — is what lets gltlint GLT025 prove every code the
+# server constructs has a client-side classification.
+FATAL_CODES = frozenset({
+    "epoch_busy",        # previous epoch still producing (caller bug)
+    "stale_epoch",       # request from a superseded epoch
+    "sampling_failed",   # server-side sampling raised
+    "producer_dead",     # producer thread/process died mid-epoch
+    "fatal",             # conn-level terminal server error
+})
+
+
 class RemoteServerConnection:
     """One logical connection to a sampling server (with failover).
 
@@ -275,6 +291,12 @@ class RemoteServerConnection:
 
             if code in SERVING_CODES:
                 raise error_from_response(resp)
+            if code in FATAL_CODES:
+                # The server's explicit non-retryable verdict: surface
+                # the code so operators (and the failover discipline)
+                # can tell it from a transport fault.
+                raise RuntimeError(
+                    f"server error [{code}]: {resp['error']}")
         raise RuntimeError(f"server error: {resp['error']}")
 
     # -- protocol ----------------------------------------------------------

@@ -99,6 +99,21 @@ DEFAULT_MAX_FRAME_BYTES = 1 << 30
 DEFAULT_LEASE_SECS = 300.0
 DEFAULT_REPLAY_WINDOW = 8
 
+# Ops that only a current-protocol server understands: an older server
+# answers each of them with its unknown-op fatal error, so every client
+# call site must degrade (return None, or pin the peer to legacy
+# routing) instead of surfacing a new failure mode.  ``fleet_hello`` is
+# the negotiation itself; the observability pulls shipped after the
+# protocol froze ride the same contract.  gltlint reads this set to
+# assign per-op minimum protocol versions (GLT026, ``--format=optable``,
+# and the mixed-version matrix in docs/distributed.md).
+POST_HELLO_OPS = frozenset({
+    "fleet_hello",
+    "fleet_shed",
+    "flight_dump",
+    "profile_capture",
+})
+
 
 class ProtocolError(RuntimeError):
     """The framed byte stream is invalid (bad length, truncated header)."""
@@ -567,6 +582,9 @@ class DistServer:
     # -- request handlers (cf. _call_func_on_server, dist_server.py:214) ---
     def _handle(self, req: dict, trace_ctx: Optional[dict] = None):
         op = req["op"]
+        # Justified (GLT024): sent by notebooks/operator tooling and the
+        # integration tests, not by any in-package client path.
+        # gltlint: disable-next=unmatched-wire-op
         if op == "get_dataset_meta":
             g = self.dataset.get_graph()
             return {"num_nodes": g.num_nodes, "num_edges": g.num_edges,
@@ -659,6 +677,10 @@ class DistServer:
             if self.serving is None:
                 return {"enabled": False}
             return {"enabled": True, **self.serving.stats()}
+        # Justified (GLT024): consumed by the scrape sidecar over the
+        # framed protocol (docs/observability.md), never by an
+        # in-package client.
+        # gltlint: disable-next=unmatched-wire-op
         if op == "get_metrics":
             # Prometheus-style text exposition (docs/observability.md):
             # a scrape sidecar (or a curl over the framed protocol) reads

@@ -333,12 +333,16 @@ class ServingFront:
                 p.fail(ServingError(f"serving engine failed: {e}"))
             return
         batch_ms = (time.perf_counter() - t0) * 1e3
-        self._ewma_batch_ms += 0.2 * (batch_ms - self._ewma_batch_ms)
         done = time.monotonic()
         for p, msg in zip(live, messages):
             p.succeed(msg)
             _H_E2E.observe((done - p.enqueued) * 1e3)
         with self._stats_lock:
+            # The EWMA update is a read-modify-write: it must share the
+            # stats lock that `stats()` reads it under (found by gltlint
+            # GLT027 — the unlocked `+=` could publish a torn/stale
+            # estimate into retry_after_ms hints under contention).
+            self._ewma_batch_ms += 0.2 * (batch_ms - self._ewma_batch_ms)
             self._dispatched_batches += 1
             self._completed += len(live)
         _M_BATCHES.inc()

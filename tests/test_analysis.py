@@ -2502,6 +2502,469 @@ class TestUnjitteredRetryLoop:
         assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+# ---------------------------------------------------------------------------
+# GLT024-026 protocol verification (two-endpoint fixture project)
+# ---------------------------------------------------------------------------
+
+# A minimal but idiomatic endpoint pair: a dispatch function (>= 2
+# ``op ==`` compares), a protocol anchor branch, a binary-frame branch,
+# and a POST_HELLO_OPS-gated op — the same shapes dist_server/dist_client
+# use, shrunk to the recognizer's essentials.
+_PROTO_SERVER = """
+POST_HELLO_OPS = frozenset({"flight_dump"})
+_KIND_MSG = 1
+
+def handle(req, conn):
+    op = req["op"]
+    if op == "ping":
+        return {"ok": True, "protocol": 1}
+    if op == "flight_dump":
+        return {"flight": []}
+    if op == "fetch":
+        conn.send_frame(_KIND_MSG, b"payload")
+        return None
+    raise ValueError(op)
+"""
+
+_PROTO_CLIENT_CLEAN = """
+def run(conn):
+    conn.request(op="ping", peer="me")
+    conn.request(op="fetch", producer_id=1)
+    try:
+        return conn.request(op="flight_dump")
+    except RuntimeError:
+        return None
+"""
+
+
+class TestUnmatchedWireOp:
+    def test_client_op_without_dispatch_branch_fires(self):
+        client = _PROTO_CLIENT_CLEAN + textwrap.dedent("""
+        def drifted(conn):
+            try:
+                conn.request(op="flight_dumpp")   # renamed server-side
+            except RuntimeError:
+                pass
+        """)
+        hits = project_findings(
+            {"pkg.server": _PROTO_SERVER, "pkg.client": client},
+            "unmatched-wire-op")
+        assert len(hits) == 1
+        assert "flight_dumpp" in hits[0].message
+        assert "unknown-op" in hits[0].message
+
+    def test_dead_dispatch_branch_fires(self):
+        client = """
+        def run(conn):
+            conn.request(op="ping", peer="me")
+            try:
+                conn.request(op="flight_dump")
+            except RuntimeError:
+                pass
+        """
+        hits = project_findings(          # nobody sends "fetch"
+            {"pkg.server": _PROTO_SERVER, "pkg.client": client},
+            "unmatched-wire-op")
+        assert len(hits) == 1
+        assert "fetch" in hits[0].message
+        assert "no in-tree client" in hits[0].message
+
+    def test_matched_endpoints_clean(self):
+        assert project_findings(
+            {"pkg.server": _PROTO_SERVER,
+             "pkg.client": _PROTO_CLIENT_CLEAN},
+            "unmatched-wire-op") == []
+
+    def test_client_only_file_set_is_silent(self):
+        """No dispatch function in the analyzed set: nothing to resolve
+        against, so nothing fires (a lint of dist_client alone must not
+        claim every op is unmatched)."""
+        assert project_findings(
+            {"pkg.client": _PROTO_CLIENT_CLEAN}, "unmatched-wire-op") == []
+
+    def test_suppression_comment(self):
+        server = _PROTO_SERVER.replace(
+            '    if op == "fetch":',
+            '    # out-of-tree caller (operator tooling)\n'
+            '    # gltlint: disable-next=unmatched-wire-op\n'
+            '    if op == "fetch":')
+        client = """
+        def run(conn):
+            conn.request(op="ping", peer="me")
+            try:
+                conn.request(op="flight_dump")
+            except RuntimeError:
+                pass
+        """
+        assert project_findings(
+            {"pkg.server": server, "pkg.client": client},
+            "unmatched-wire-op") == []
+
+
+class TestUnclassifiedErrorCode:
+    _SERVER_WITH_CODE = _PROTO_SERVER + textwrap.dedent("""
+    def fail(conn, e):
+        conn.send({"error": str(e), "code": "weird_fault"})
+    """)
+
+    def test_unrecognized_code_fires(self):
+        hits = project_findings(
+            {"pkg.server": self._SERVER_WITH_CODE,
+             "pkg.client": _PROTO_CLIENT_CLEAN},
+            "unclassified-error-code")
+        assert len(hits) == 1
+        assert "weird_fault" in hits[0].message
+
+    def test_codes_set_membership_recognizes(self):
+        client = _PROTO_CLIENT_CLEAN + textwrap.dedent("""
+        FATAL_CODES = frozenset({"weird_fault"})
+        """)
+        assert project_findings(
+            {"pkg.server": self._SERVER_WITH_CODE, "pkg.client": client},
+            "unclassified-error-code") == []
+
+    def test_typed_exception_code_attr_recognizes(self):
+        client = _PROTO_CLIENT_CLEAN + textwrap.dedent("""
+        class WeirdFault(RuntimeError):
+            code = "weird_fault"
+        """)
+        assert project_findings(
+            {"pkg.server": self._SERVER_WITH_CODE, "pkg.client": client},
+            "unclassified-error-code") == []
+
+    def test_explicit_comparison_recognizes(self):
+        client = _PROTO_CLIENT_CLEAN + textwrap.dedent("""
+        def classify(resp):
+            if resp.get("code") == "weird_fault":
+                raise RuntimeError("weird")
+        """)
+        assert project_findings(
+            {"pkg.server": self._SERVER_WITH_CODE, "pkg.client": client},
+            "unclassified-error-code") == []
+
+    def test_getattr_field_selector_is_not_a_code(self):
+        """``getattr(e, "code", "io_failed")``: only the default can flow
+        into the wire code — the attribute name must not be inventoried
+        (the calibration bug that flagged the string ``"code"``)."""
+        server = _PROTO_SERVER + textwrap.dedent("""
+        def fail(conn, e):
+            conn.send({"error": str(e),
+                       "code": getattr(e, "code", "io_failed")})
+        """)
+        client = _PROTO_CLIENT_CLEAN + textwrap.dedent("""
+        IO_CODES = ("io_failed",)
+        """)
+        assert project_findings(
+            {"pkg.server": server, "pkg.client": client},
+            "unclassified-error-code") == []
+
+
+class TestMissingMixedVersionFallback:
+    def test_bare_gated_send_fires(self):
+        client = """
+        def run(conn):
+            conn.request(op="ping", peer="me")
+            return conn.request(op="flight_dump")   # no fallback
+        """
+        hits = project_findings(
+            {"pkg.server": _PROTO_SERVER, "pkg.client": client},
+            "missing-mixed-version-fallback")
+        assert len(hits) == 1
+        assert "flight_dump" in hits[0].message
+        assert "protocol >= 1" in hits[0].message
+
+    def test_guarded_send_clean(self):
+        assert project_findings(
+            {"pkg.server": _PROTO_SERVER,
+             "pkg.client": _PROTO_CLIENT_CLEAN},
+            "missing-mixed-version-fallback") == []
+
+    def test_dict_built_outside_try_with_guarded_send_clean(self):
+        """The profile_capture spelling: the request dict is assembled
+        at the top of the function, the ``request(**req)`` send sits in
+        the try — the site degrades even though the literal does not."""
+        client = """
+        def run(conn, millis):
+            req = {"op": "flight_dump", "millis": millis}
+            try:
+                return conn.request(**req)
+            except RuntimeError:
+                return None
+        """
+        assert project_findings(
+            {"pkg.server": _PROTO_SERVER, "pkg.client": client},
+            "missing-mixed-version-fallback") == []
+
+    def test_protocol0_ops_need_no_fallback(self):
+        client = """
+        def run(conn):
+            return conn.request(op="ping", peer="me")
+        """
+        assert project_findings(
+            {"pkg.server": _PROTO_SERVER, "pkg.client": client},
+            "missing-mixed-version-fallback") == []
+
+
+class TestOpTableExtraction:
+    def _table(self):
+        from glt_tpu.analysis.protocol import extract_op_table
+        return extract_op_table(make_project(
+            {"pkg.server": _PROTO_SERVER,
+             "pkg.client": _PROTO_CLIENT_CLEAN}))
+
+    def test_ops_and_protocol(self):
+        table = self._table()
+        assert set(table.ops) == {"ping", "fetch", "flight_dump"}
+        assert table.protocol == 1
+
+    def test_min_protocol_from_post_hello_ops(self):
+        table = self._table()
+        assert table.ops["flight_dump"].min_protocol == 1
+        assert table.ops["ping"].min_protocol == 0
+
+    def test_frame_kind_from_kind_constant(self):
+        table = self._table()
+        assert table.ops["fetch"].frame == "msg"
+        assert table.ops["ping"].frame == "json"
+
+    def test_request_and_response_keys(self):
+        table = self._table()
+        assert table.ops["ping"].request_keys == {"peer"}
+        assert table.ops["fetch"].request_keys == {"producer_id"}
+        assert table.ops["ping"].response_keys == {"ok", "protocol"}
+
+    def test_markdown_matrix_rows(self):
+        from glt_tpu.analysis.protocol import format_op_table
+        text = format_op_table(self._table())
+        assert "| `flight_dump` | json | 1 |" in text
+        assert "| `fetch` | msg | 0 | producer_id | (msg frame) |" in text
+
+    def test_real_tree_dump_lists_every_wire_op(self):
+        """The acceptance bar: the dump over glt_tpu covers the full
+        PR-19 protocol surface, fleet and serving ops included."""
+        proc = _run_cli("--format=optable")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        for op in ("create_sampling_producer", "fetch_one_sampled_message",
+                   "fleet_hello", "fleet_shed", "flight_dump",
+                   "profile_capture", "subgraph_request", "heartbeat"):
+            assert f"`{op}`" in proc.stdout, op
+
+    def test_docs_matrix_matches_generated(self):
+        """The committed block in docs/distributed.md IS the generated
+        table (mirrors the CI drift check)."""
+        import re
+        proc = _run_cli("--format=optable")
+        doc = open(os.path.join(REPO, "docs", "distributed.md")).read()
+        m = re.search(r"<!-- optable:begin[^>]*-->\n(.*?)<!-- optable:end -->",
+                      doc, re.S)
+        assert m, "optable markers missing from docs/distributed.md"
+        assert proc.stdout.strip() == m.group(1).strip()
+
+
+# ---------------------------------------------------------------------------
+# GLT027 unguarded-shared-field
+# ---------------------------------------------------------------------------
+
+class TestUnguardedSharedField:
+    def test_rmw_missing_the_fields_lock_fires(self):
+        """The serving/front.py calibration catch: an EWMA read-modify-
+        write outside the lock its reader holds."""
+        src = """
+        import threading
+
+        class Front:
+            def __init__(self):
+                self._stats_lock = threading.Lock()
+                self._ewma = 0.0
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def _loop(self):
+                while True:
+                    self._ewma += 0.1
+
+            def stats(self):
+                with self._stats_lock:
+                    return {"ewma": self._ewma}
+        """
+        hits = project_findings({"pkg.front": src},
+                                "unguarded-shared-field")
+        assert len(hits) == 1
+        assert "_ewma" in hits[0].message
+        assert "misses the field's locking discipline" in hits[0].message
+
+    def test_inconsistent_locking_fires(self):
+        src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                while True:
+                    with self._lock:
+                        self._n += 1
+
+            def bump(self):
+                self._n += 1
+        """
+        hits = project_findings({"pkg.w": src}, "unguarded-shared-field")
+        assert len(hits) == 1
+        assert "inconsistent locking" in hits[0].message
+
+    def test_multi_domain_lockfree_writes_fire(self):
+        src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._n = 0
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                while True:
+                    self._n += 1
+
+            def bump(self):
+                self._n += 1
+        """
+        hits = project_findings({"pkg.w": src}, "unguarded-shared-field")
+        assert len(hits) == 1
+        assert "multiple thread domains" in hits[0].message
+
+    def test_atomic_publish_via_replace_exempt(self):
+        """Single-writer plain assigns (the fleet_shed ``_shed_frac``
+        idiom): readers see old-or-new, never torn."""
+        src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._frac = 0.0
+                threading.Thread(target=self._loop).start()
+
+            def set_frac(self, f):
+                self._frac = float(f)
+
+            def _loop(self):
+                while True:
+                    print(self._frac)
+        """
+        assert project_findings({"pkg.w": src},
+                                "unguarded-shared-field") == []
+
+    def test_single_writer_counter_exempt(self):
+        """RMW counters owned by one thread with no locked access
+        anywhere (the HeartbeatSender ``sent`` idiom)."""
+        src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self.sent = 0
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                while True:
+                    self.sent += 1
+
+            def read(self):
+                return self.sent
+        """
+        assert project_findings({"pkg.w": src},
+                                "unguarded-shared-field") == []
+
+    def test_queue_handoff_exempt(self):
+        src = """
+        import queue
+        import threading
+
+        class W:
+            def __init__(self):
+                self._q = queue.Queue(maxsize=8)
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                while True:
+                    self._q.put(1, timeout=1.0)
+
+            def drain(self):
+                return self._q.get(timeout=1.0)
+        """
+        assert project_findings({"pkg.w": src},
+                                "unguarded-shared-field") == []
+
+    def test_common_lock_over_all_writes_clean(self):
+        src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                while True:
+                    with self._lock:
+                        self._n += 1
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+        """
+        assert project_findings({"pkg.w": src},
+                                "unguarded-shared-field") == []
+
+    def test_no_thread_entries_is_silent(self):
+        """Without a ``Thread(target=...)`` spawn the class is
+        single-threaded by construction — nothing to check."""
+        src = """
+        class W:
+            def __init__(self):
+                self._n = 0
+
+            def bump(self):
+                self._n += 1
+        """
+        assert project_findings({"pkg.w": src},
+                                "unguarded-shared-field") == []
+
+    def test_suppression_comment(self):
+        src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._n = 0
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                while True:
+                    # benign drift: approximate stat
+                    # gltlint: disable-next=unguarded-shared-field
+                    self._n += 1
+
+            def bump(self):
+                self._n += 1
+        """
+        assert project_findings({"pkg.w": src},
+                                "unguarded-shared-field") == []
+
+
+def test_protocol_rules_clean_on_distributed_and_serving():
+    """Real-tree smoke: the fleet contracts verify clean — the op table
+    resolves, every server code classifies, every gated send degrades,
+    every shared field is locked or sanctioned."""
+    proc = _run_cli("glt_tpu",
+                    "--select=GLT024,GLT025,GLT026,GLT027")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 error(s)" in proc.stdout
+
+
 def test_device_program_rules_clean_on_ops_and_parallel():
     """Real-tree smoke: the device-program passes (GLT017-021) verify
     every committed kernel and shard_map body with zero findings —
@@ -2533,6 +2996,8 @@ def test_rule_registry_complete():
         "unaligned-tile-shape", "divergent-collective",
         "unknown-axis-name", "lossy-dtype-narrowing",
         "unjittered-retry-loop",
+        "unmatched-wire-op", "unclassified-error-code",
+        "missing-mixed-version-fallback", "unguarded-shared-field",
     }
 
 
@@ -2568,8 +3033,18 @@ def test_cli_perf_guard():
             passes[parts[parts.index("pass") + 1]] = float(parts[-2])
     assert "vmem-budget-exceeded" in passes     # new passes are timed
     assert "divergent-collective" in passes
+    assert "unmatched-wire-op" in passes        # v4 protocol pass
+    assert "unguarded-shared-field" in passes   # v4 threads pass
     for name, ms in passes.items():
         assert ms < 5000.0, f"pass {name} took {ms:.0f}ms (budget 5s)"
+    # incremental mode shares the same budget and reports its slice
+    t0 = time.monotonic()
+    proc = _run_cli("glt_tpu", "--since=HEAD", "--profile")
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert ("incremental slice:" in proc.stderr
+            or "needs git" in proc.stderr)      # git-less env falls back
+    assert elapsed < 10.0, f"--since run took {elapsed:.1f}s"
 
 
 def test_cli_flags_a_violation(tmp_path):
@@ -2596,7 +3071,8 @@ def test_cli_list_rules():
     assert proc.returncode == 0
     for code in ("GLT001", "GLT002", "GLT003", "GLT004", "GLT005",
                  "GLT006", "GLT007", "GLT008", "GLT009",
-                 "GLT017", "GLT018", "GLT019", "GLT020", "GLT021"):
+                 "GLT017", "GLT018", "GLT019", "GLT020", "GLT021",
+                 "GLT024", "GLT025", "GLT026", "GLT027"):
         assert code in proc.stdout
 
 
@@ -2606,6 +3082,62 @@ def test_cli_single_rule_mode():
     proc = _run_cli("glt_tpu/ops", "--rule=GLT017")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "0 error(s)" in proc.stdout
+
+
+def test_cli_single_rule_glt024_under_profile_guard():
+    """The op-table extraction is a project-wide pass; single-rule mode
+    over the whole tree must still clear the 5 s profile guard."""
+    import time
+    t0 = time.monotonic()
+    proc = _run_cli("glt_tpu", "--rule=GLT024", "--profile")
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert elapsed < 5.0, f"--rule=GLT024 took {elapsed:.1f}s (budget 5s)"
+
+
+def _git(*args, cwd):
+    subprocess.run(
+        ["git", "-c", "user.name=t", "-c", "user.email=t@t.invalid",
+         *args],
+        cwd=cwd, check=True, capture_output=True)
+
+
+def test_cli_changed_mode_slices_to_dirty_files(tmp_path):
+    """``--changed`` lints only what git reports dirty vs HEAD: a
+    committed violation stays quiet until the file itself changes,
+    while untracked files are always in the slice."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x)
+    """))
+    _git("init", "-q", cwd=tmp_path)
+    _git("add", "-A", cwd=tmp_path)
+    _git("commit", "-qm", "seed", cwd=tmp_path)
+    clean = tmp_path / "clean.py"           # untracked, violation-free
+    clean.write_text("x = 1\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "glt_tpu.analysis",
+             str(bad), str(clean), *extra],
+            cwd=tmp_path, env=env, capture_output=True, text=True,
+            timeout=120)
+
+    proc = run()                            # full run: violation fires
+    assert proc.returncode == 1 and "GLT001" in proc.stdout
+    proc = run("--changed", "--profile")    # slice: only clean.py dirty
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "incremental slice: 1 changed file(s)" in proc.stderr
+    bad.write_text(bad.read_text() + "\n# touched\n")
+    proc = run("--changed")                 # now bad.py is in the slice
+    assert proc.returncode == 1 and "GLT001" in proc.stdout
 
 
 def test_cli_rule_rejects_lists_and_select():
